@@ -1,0 +1,25 @@
+"""hstream-tpu: a TPU-native streaming database framework.
+
+Capabilities mirror the HStreamDB reference (Yu-zh/hstream): persistent
+pub/sub streams over a log store, SQL continuous queries with windowed
+aggregation, materialized views with pull queries, subscriptions, and
+source/sink connectors — but the continuous-query hot path executes as
+XLA-compiled micro-batch kernels over device-resident state lattices
+instead of a per-record interpreted processor DAG.
+
+Layer map (bottom up), mirroring the reference's capability boundaries
+(see SURVEY.md §1):
+
+  store/       durable log store (C++ core + in-memory test backend)
+  common/      record codec, id generation, logging, errors
+  engine/      logical plans + the jitted TPU window-aggregation executor
+  parallel/    device-mesh sharding of engine state (dp over records,
+               kp over keys) using shard_map + XLA collectives
+  sql/         SQL lexer/parser/AST -> validated plan -> engine plan
+  server/      gRPC HStreamApi service, subscriptions, metadata persistence
+  connectors/  hstore source/sink, MySQL / ClickHouse sinks
+  client/      SQL REPL and client actions
+  stats/       per-stream counters and time-series rates
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,103 @@
+"""Exception hierarchy for hstream-tpu.
+
+The reference maps low-level store error codes to a typed exception table
+(hstream-store/HStream/Store/Exception.hs) and catches them at the server
+boundary into gRPC statuses (hstream/src/HStream/Server/Exception.hs:27-50).
+We keep a compact hierarchy with the same separation: store errors, SQL
+errors, server/user errors — each knows its gRPC status code.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+
+class HStreamError(Exception):
+    grpc_status = grpc.StatusCode.INTERNAL
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message
+
+
+# ---- store -----------------------------------------------------------------
+
+class StoreError(HStreamError):
+    pass
+
+
+class StreamNotFound(StoreError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class StreamExists(StoreError):
+    grpc_status = grpc.StatusCode.ALREADY_EXISTS
+
+
+class LogNotFound(StoreError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class CheckpointNotFound(StoreError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class StoreIOError(StoreError):
+    pass
+
+
+# ---- SQL -------------------------------------------------------------------
+
+class SQLError(HStreamError):
+    grpc_status = grpc.StatusCode.INVALID_ARGUMENT
+
+    def __init__(self, message: str, pos: tuple[int, int] | None = None):
+        super().__init__(message)
+        self.pos = pos  # (line, column), 1-based
+
+    def __str__(self) -> str:
+        if self.pos:
+            return f"{self.message} at line {self.pos[0]}, column {self.pos[1]}"
+        return self.message
+
+
+class SQLParseError(SQLError):
+    pass
+
+
+class SQLValidateError(SQLError):
+    pass
+
+
+class SQLCodegenError(SQLError):
+    pass
+
+
+# ---- server ----------------------------------------------------------------
+
+class ServerError(HStreamError):
+    pass
+
+
+class SubscriptionNotFound(ServerError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class SubscriptionExists(ServerError):
+    grpc_status = grpc.StatusCode.ALREADY_EXISTS
+
+
+class QueryNotFound(ServerError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class ViewNotFound(ServerError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class ConnectorNotFound(ServerError):
+    grpc_status = grpc.StatusCode.NOT_FOUND
+
+
+class QueryTerminated(ServerError):
+    grpc_status = grpc.StatusCode.ABORTED

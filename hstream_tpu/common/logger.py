@@ -1,0 +1,31 @@
+"""Structured logging for hstream-tpu.
+
+The reference uses a leveled, colored builder logger (common/HStream/Logger.hs);
+here we configure the stdlib logger once with the same spirit: level control via
+HSTREAM_LOG_LEVEL, compact single-line format with timestamps.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(levelname).1s %(name)s: %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("HSTREAM_LOG_LEVEL", "INFO").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        root = logging.getLogger("hstream_tpu")
+        root.addHandler(handler)
+        root.setLevel(getattr(logging, level, logging.INFO))
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(f"hstream_tpu.{name}")

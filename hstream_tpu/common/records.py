@@ -1,0 +1,107 @@
+"""Record codec and JSON helpers.
+
+Mirrors the capability of the reference's record plumbing
+(common/HStream/Utils/BuildRecord.hs:28-70 builds/parses `HStreamRecord`
+protobufs with a publish timestamp; common/HStream/Utils.hs:42-55 flattens
+nested JSON for connector sinks). Payloads flagged JSON carry a serialized
+`google.protobuf.Struct`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Mapping
+
+from google.protobuf import struct_pb2
+
+from hstream_tpu.proto import api_pb2 as pb
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def dict_to_struct(d: Mapping[str, Any]) -> struct_pb2.Struct:
+    s = struct_pb2.Struct()
+    # Struct.update handles nested dicts/lists/scalars.
+    s.update(d)
+    return s
+
+
+def _value_to_py(v: struct_pb2.Value) -> Any:
+    kind = v.WhichOneof("kind")
+    if kind == "null_value":
+        return None
+    if kind == "number_value":
+        n = v.number_value
+        return int(n) if float(n).is_integer() else n
+    if kind == "string_value":
+        return v.string_value
+    if kind == "bool_value":
+        return v.bool_value
+    if kind == "struct_value":
+        return struct_to_dict(v.struct_value)
+    if kind == "list_value":
+        return [_value_to_py(x) for x in v.list_value.values]
+    return None
+
+
+def struct_to_dict(s: struct_pb2.Struct) -> dict[str, Any]:
+    """Struct -> plain dict, decoding integral floats back to ints."""
+    return {k: _value_to_py(v) for k, v in s.fields.items()}
+
+
+def build_record(
+    payload: Mapping[str, Any] | bytes,
+    *,
+    key: str = "",
+    attributes: Mapping[str, str] | None = None,
+    publish_time_ms: int | None = None,
+) -> pb.HStreamRecord:
+    """Build an HStreamRecord. A mapping payload is encoded as a JSON Struct;
+    bytes are carried raw."""
+    if isinstance(payload, (bytes, bytearray)):
+        flag = pb.RECORD_FLAG_RAW
+        body = bytes(payload)
+    else:
+        flag = pb.RECORD_FLAG_JSON
+        body = dict_to_struct(payload).SerializeToString()
+    header = pb.HStreamRecordHeader(
+        flag=flag,
+        publish_time_ms=now_ms() if publish_time_ms is None else publish_time_ms,
+        key=key,
+    )
+    if attributes:
+        header.attributes.update(attributes)
+    return pb.HStreamRecord(header=header, payload=body)
+
+
+def parse_record(data: bytes) -> pb.HStreamRecord:
+    return pb.HStreamRecord.FromString(data)
+
+
+def payload_to_struct(rec: pb.HStreamRecord) -> struct_pb2.Struct | None:
+    """Decode a JSON-flagged record's payload; None for raw records."""
+    if rec.header.flag != pb.RECORD_FLAG_JSON:
+        return None
+    return struct_pb2.Struct.FromString(rec.payload)
+
+
+def record_to_dict(rec: pb.HStreamRecord) -> dict[str, Any] | None:
+    s = payload_to_struct(rec)
+    return None if s is None else struct_to_dict(s)
+
+
+def flatten_json(d: Mapping[str, Any], *, sep: str = ".") -> dict[str, Any]:
+    """Flatten nested objects: {"a": {"b": 1}} -> {"a.b": 1}.
+
+    Used by relational sinks (MySQL/ClickHouse) which need flat columns,
+    matching the reference's flattening of nested JSON objects."""
+    out: dict[str, Any] = {}
+    for k, v in d.items():
+        if isinstance(v, Mapping):
+            for kk, vv in flatten_json(v, sep=sep).items():
+                out[f"{k}{sep}{kk}"] = vv
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,57 @@
+"""The TPU continuous-query engine.
+
+The reference executes queries as a per-record interpreted processor DAG
+(hstream-processing Processor.hs:282-297 — `forward` walks a HashMap of
+closures record by record). The idiomatic TPU design inverts this:
+
+  * records are staged into fixed-capacity **columnar micro-batches**
+  * the whole query (filter -> project -> window assignment -> grouped
+    aggregation) is **compiled once with jax.jit** into a step function
+  * aggregate state lives on device as a dense **lattice**
+    `[keys, window-slots, accumulators]`; per-batch updates are
+    scatter-adds/mins/maxes that XLA fuses into a handful of kernels
+  * window close is driven by a host-side watermark; closing extracts and
+    resets one slot column — off the hot path
+  * all accumulators are commutative monoids (count/sum/min/max/HLL
+    registers/histogram bins), so multi-chip scaling is data-parallel
+    sharding of batches with a merge collective at window close
+    (see hstream_tpu.parallel)
+
+Timestamps on device are int32 milliseconds relative to a per-query epoch
+(int64 is unavailable without x64); the epoch is rebased on host when the
+stream outlives the int32 range.
+"""
+
+from hstream_tpu.engine.types import ColumnType, Schema, HostBatch
+from hstream_tpu.engine.window import TumblingWindow, HoppingWindow, SessionWindow
+from hstream_tpu.engine.plan import (
+    AggKind,
+    AggSpec,
+    PlanNode,
+    SourceNode,
+    FilterNode,
+    ProjectNode,
+    AggregateNode,
+    JoinNode,
+    SinkNode,
+)
+from hstream_tpu.engine.executor import QueryExecutor
+
+__all__ = [
+    "ColumnType",
+    "Schema",
+    "HostBatch",
+    "TumblingWindow",
+    "HoppingWindow",
+    "SessionWindow",
+    "AggKind",
+    "AggSpec",
+    "PlanNode",
+    "SourceNode",
+    "FilterNode",
+    "ProjectNode",
+    "AggregateNode",
+    "JoinNode",
+    "SinkNode",
+    "QueryExecutor",
+]

@@ -1,0 +1,452 @@
+"""Host-side query executor: drives the jitted lattice step.
+
+Responsibilities (the reference spreads these across runTask's polling
+loop and the aggregate processors — Processor.hs:99-144,
+TimeWindowedStream.hs:82-103):
+
+  * columnarize decoded JSON rows into padded HostBatches
+  * maintain the group-key dictionary (tuple of group values <-> dense id)
+  * maintain the time epoch: device time = int32 ms relative to `epoch`,
+    re-anchored (rebase) long before int32 overflow
+  * track the watermark (max event time seen = the reference's
+    `observedStreamTime`) and the set of open windows ON HOST, so the
+    device step never syncs back per batch
+  * when the watermark passes win_end + grace: extract + reset that slot
+    (window close), finalize, decode keys, apply HAVING + projections
+  * EMIT CHANGES mode: additionally extract touched (key, window) pairs
+    after each batch (one change per touched pair per micro-batch — the
+    batched analogue of the reference's per-record emission)
+
+The executor is single-threaded per query, like the reference's one green
+thread per task; concurrency comes from running many executors and from
+the device pipelining enqueued steps.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.engine import lattice
+from hstream_tpu.engine.expr import (
+    BinOp,
+    Col,
+    Expr,
+    columns_of,
+    encode_strings,
+    eval_host,
+)
+from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec
+from hstream_tpu.engine.types import (
+    ColumnType,
+    HostBatch,
+    Schema,
+    StringDictionary,
+    round_up_pow2,
+)
+from hstream_tpu.engine.window import FixedWindow, SessionWindow
+
+REBASE_THRESHOLD = 1 << 30  # re-anchor epoch when relative time passes this
+
+EmitFn = Callable[[list[dict[str, Any]]], None]
+
+
+def _align_down(ts: int, step: int) -> int:
+    return ts - (ts % step)
+
+
+@dataclass
+class _OpenWindow:
+    start_abs: int  # absolute ms
+    slot: int
+
+
+class QueryExecutor:
+    """Executes one windowed/global GROUP BY aggregation plan."""
+
+    def __init__(
+        self,
+        node: AggregateNode,
+        schema: Schema,
+        *,
+        emit_changes: bool = True,
+        initial_keys: int = 1024,
+        batch_capacity: int = 4096,
+    ):
+        if isinstance(node.window, SessionWindow):
+            raise SQLCodegenError("session windows use SessionExecutor")
+        self.node = node
+        self.schema = schema
+        self.emit_changes = emit_changes
+        self.batch_capacity = batch_capacity
+
+        # group keys must be plain columns (validated upstream)
+        self.group_cols: list[str] = []
+        for k in node.group_keys:
+            if not isinstance(k, Col):
+                raise SQLCodegenError("GROUP BY supports plain columns")
+            self.group_cols.append(k.name)
+
+        self.window: FixedWindow | None = node.window
+        self.dicts: dict[str, StringDictionary] = {
+            name: StringDictionary() for name, t in schema.fields
+            if t == ColumnType.STRING
+        }
+
+        self._key_ids: dict[tuple, int] = {}
+        self._key_rev: list[tuple] = []
+
+        # Pre-encode string literals (fills the column dictionaries) so the
+        # expressions are hashable and compiled functions can be shared.
+        encoded_aggs = []
+        for agg in node.aggs:
+            if agg.input is not None:
+                agg = AggSpec(kind=agg.kind, out_name=agg.out_name,
+                              input=encode_strings(agg.input, schema, self.dicts),
+                              quantile=agg.quantile, k=agg.k)
+            encoded_aggs.append(agg)
+        self._filter_expr = self._extract_filter()
+        if self._filter_expr is not None:
+            self._filter_expr = encode_strings(
+                self._filter_expr, schema, self.dicts)
+
+        # columns the device step actually needs
+        needed = set()
+        for agg in encoded_aggs:
+            if agg.input is not None:
+                needed |= columns_of(agg.input)
+        if self._filter_expr is not None:
+            needed |= columns_of(self._filter_expr)
+        self._needed_cols = sorted(needed)
+
+        self.spec = lattice.LatticeSpec(
+            n_keys=initial_keys, window=self.window, aggs=tuple(encoded_aggs))
+        self.state = lattice.init_state(self.spec)
+        self._compile()
+
+        self.epoch: int | None = None        # absolute ms anchor, advance-aligned
+        self.watermark_abs: int = -1
+        self._open: dict[int, _OpenWindow] = {}  # start_abs -> window
+        self.rebase_threshold = REBASE_THRESHOLD
+
+    def _extract_filter(self) -> Expr | None:
+        # Walk the child chain down to the source, ANDing every FilterNode
+        # predicate; reject node types this executor cannot honor so a
+        # malformed plan fails loudly instead of silently skipping filters.
+        from hstream_tpu.engine.plan import FilterNode, SourceNode
+
+        pred: Expr | None = None
+        child = self.node.child
+        while not isinstance(child, SourceNode):
+            if isinstance(child, FilterNode):
+                pred = child.predicate if pred is None else \
+                    BinOp("AND", pred, child.predicate)
+                child = child.child
+            else:
+                raise SQLCodegenError(
+                    f"aggregate over unsupported child node "
+                    f"{type(child).__name__}")
+        return pred
+
+    def _compile(self) -> None:
+        n_per = self.spec.windows_per_record
+        fns = lattice.compiled(self.spec, self.schema, self._filter_expr,
+                               self.batch_capacity * n_per)
+        self._step = fns.step
+        self._extract_slot = fns.extract_slot
+        self._reset_slot = fns.reset_slot
+        self._extract_touched = fns.extract_touched
+        self._agg_null_cols = {
+            key: sorted(columns_of(agg.input))
+            for key, agg in zip(fns.null_keys, self.spec.aggs)
+            if key is not None
+        }
+
+    # ---- keys --------------------------------------------------------------
+
+    def _key_id(self, row: Mapping[str, Any]) -> int:
+        key = tuple(row.get(c) for c in self.group_cols)
+        kid = self._key_ids.get(key)
+        if kid is None:
+            kid = len(self._key_rev)
+            if kid >= self.spec.n_keys:
+                self._grow_keys()
+            self._key_ids[key] = kid
+            self._key_rev.append(key)
+        return kid
+
+    def _grow_keys(self) -> None:
+        new_k = self.spec.n_keys * 2
+        self.state = lattice.grow_keys(self.state, self.spec, new_k)
+        self.spec = lattice.LatticeSpec(
+            n_keys=new_k, window=self.spec.window, aggs=self.spec.aggs,
+            hll=self.spec.hll, qcfg=self.spec.qcfg)
+        self._compile()
+
+    # ---- time --------------------------------------------------------------
+
+    def _advance_step(self) -> int:
+        return 1 if self.window is None else self.window.advance_ms
+
+    def _ensure_epoch(self, min_ts: int) -> None:
+        if self.epoch is None:
+            # anchor so every window that can ever legally receive records
+            # has a non-negative relative start: hopping windows reach back
+            # size - advance before the first record, and out-of-order
+            # records within the grace period reach back another
+            # size + grace (window valid while start + size + grace > wm,
+            # and the watermark only grows from the first batch's max).
+            if self.window is None:
+                back = 0
+            else:
+                w = self.window
+                adv = w.advance_ms
+                back = (w.size_ms - adv) + \
+                    ((w.size_ms + w.grace_ms + adv - 1) // adv) * adv
+            self.epoch = _align_down(min_ts, self._advance_step()) - back
+
+    def _maybe_rebase(self, max_ts_abs: int) -> None:
+        if self.epoch is None:
+            return
+        if max_ts_abs - self.epoch < self.rebase_threshold:
+            return
+        # Re-anchor at the oldest still-open window (or the watermark).
+        # delta must be a multiple of advance * n_slots so the slot
+        # mapping (start // advance) mod W of every open window is
+        # preserved across the rebase.
+        anchor = min([w.start_abs for w in self._open.values()]
+                     + [self.watermark_abs if self.watermark_abs >= 0 else max_ts_abs])
+        period = self._advance_step() * self.spec.n_slots
+        delta = _align_down(anchor - self.epoch, period)
+        if delta <= 0:
+            return
+        self.state = lattice.rebase(self.state, np.int32(delta))
+        self.epoch = self.epoch + delta
+
+    # ---- ingest ------------------------------------------------------------
+
+    def process(self, rows: Sequence[Mapping[str, Any]],
+                ts_ms: Sequence[int]) -> list[dict[str, Any]]:
+        """Feed one micro-batch of decoded records; returns emitted rows."""
+        if not rows:
+            return []
+        if len(rows) > self.batch_capacity:
+            out = []
+            for i in range(0, len(rows), self.batch_capacity):
+                out.extend(self.process(rows[i:i + self.batch_capacity],
+                                        ts_ms[i:i + self.batch_capacity]))
+            return out
+
+        # Slot-collision guard: a window W*advance newer than the oldest
+        # still-open window would land in the same lattice slot. If this
+        # batch spans that far (a stream gap / restart), split it in time
+        # order and force-close due windows in between; the watermark then
+        # advances at sub-batch granularity.
+        if self.window is not None:
+            w = self.window
+            back = w.size_ms - w.advance_ms
+            aligned_min = _align_down(min(ts_ms), w.advance_ms) - back
+            anchor = min([ow for ow in self._open] + [aligned_min])
+            threshold = anchor + (self.spec.n_slots - 1) * w.advance_ms
+            if max(ts_ms) > threshold:
+                order = sorted(range(len(rows)), key=lambda i: ts_ms[i])
+                pre = [i for i in order if ts_ms[i] <= threshold]
+                suf = [i for i in order if ts_ms[i] > threshold]
+                out = []
+                if pre:
+                    out.extend(self.process([rows[i] for i in pre],
+                                            [ts_ms[i] for i in pre]))
+                # Close the windows the suffix's watermark will make due,
+                # advancing the watermark only to their close boundaries —
+                # suffix records within grace of still-open windows keep
+                # the semantics the non-split path gives them.
+                prospective = max(ts_ms[i] for i in suf)
+                due = [s for s in self._open
+                       if s + w.size_ms + w.grace_ms <= prospective]
+                if due:
+                    boundary = max(s + w.size_ms + w.grace_ms for s in due)
+                    self.watermark_abs = max(self.watermark_abs, boundary)
+                    out.extend(self.close_due_windows())
+                out.extend(self.process([rows[i] for i in suf],
+                                        [ts_ms[i] for i in suf]))
+                return out
+
+        self._ensure_epoch(min(ts_ms))
+        self._maybe_rebase(max(ts_ms))
+
+        n = len(rows)
+        cap = round_up_pow2(n)
+        key_ids = np.zeros(cap, dtype=np.int32)
+        for i, row in enumerate(rows):
+            key_ids[i] = self._key_id(row)
+
+        batch = HostBatch.from_rows(self.schema, rows, ts_ms, self.dicts,
+                                    capacity=cap)
+        ts_rel64 = np.asarray(ts_ms, dtype=np.int64) - self.epoch
+        if int(ts_rel64.max()) >= (1 << 31):
+            # epoch couldn't rebase far enough (an ancient window is still
+            # open with an extreme grace) — fail loudly over corrupting.
+            raise OverflowError(
+                "stream time span exceeds int32 relative range; "
+                "reduce grace or close the stalled window")
+        ts_rel = np.zeros(cap, dtype=np.int32)
+        ts_rel[:n] = ts_rel64
+
+        wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
+                          if self.watermark_abs >= 0 else -1)
+
+        cols = {name: batch.cols[name] for name in self._needed_cols}
+        # SQL NULL handling: a NULL operand makes the WHERE predicate
+        # not-true (row excluded) and excludes the row from that aggregate.
+        valid = batch.valid
+        if self._filter_expr is not None:
+            fm = np.zeros(cap, dtype=np.bool_)
+            for c in columns_of(self._filter_expr):
+                fm |= batch.nulls[c]
+            valid = valid & ~fm
+        for null_key, refs in self._agg_null_cols.items():
+            nm = np.zeros(cap, dtype=np.bool_)
+            for c in refs:
+                nm |= batch.nulls[c]
+            cols[null_key] = nm
+        self.state = self._step(self.state, wm_rel, key_ids, ts_rel,
+                                valid, cols)
+
+        # host window bookkeeping
+        out: list[dict[str, Any]] = []
+        if self.window is not None:
+            self._track_windows(np.asarray(ts_ms, dtype=np.int64))
+        new_wm = max(ts_ms)
+        if new_wm > self.watermark_abs:
+            self.watermark_abs = new_wm
+
+        if self.emit_changes:
+            out.extend(self._drain_changes())
+        out_closed = self.close_due_windows()
+        out.extend(out_closed)
+        return out
+
+    def _track_windows(self, ts_abs: np.ndarray) -> None:
+        w = self.window
+        advance = w.advance_ms
+        latest = ts_abs - (ts_abs % advance)
+        starts: set[int] = set()
+        for j in range(w.windows_per_record):
+            starts.update((latest - j * advance).tolist())
+        wm = self.watermark_abs
+        for s in starts:
+            if s < self.epoch:
+                continue
+            if wm >= 0 and s + w.size_ms + w.grace_ms <= wm:
+                continue  # late, dropped on device too
+            if s not in self._open:
+                slot = (((s - self.epoch) // advance) % self.spec.n_slots)
+                self._open[s] = _OpenWindow(start_abs=s, slot=slot)
+
+    # ---- emission ----------------------------------------------------------
+
+    def _decode_key(self, kid: int) -> dict[str, Any]:
+        return dict(zip(self.group_cols, self._key_rev[kid]))
+
+    def _postprocess(self, row: dict[str, Any]) -> dict[str, Any] | None:
+        if self.node.having is not None:
+            if not eval_host(self.node.having, row):
+                return None
+        if self.node.post_projections:
+            projected = {}
+            for name, expr in self.node.post_projections:
+                projected[name] = eval_host(expr, row)
+            # keep window metadata
+            for meta in ("winStart", "winEnd"):
+                if meta in row:
+                    projected[meta] = row[meta]
+            return projected
+        return row
+
+    def _agg_row(self, kid: int, outs: Mapping[str, np.ndarray], idx: int,
+                 win_start_abs: int | None) -> dict[str, Any] | None:
+        row = self._decode_key(kid)
+        for name, arr in outs.items():
+            v = float(arr[idx])
+            spec = next(a for a in self.spec.aggs if a.out_name == name)
+            if spec.kind in (AggKind.COUNT_ALL, AggKind.COUNT,
+                             AggKind.APPROX_COUNT_DISTINCT):
+                v = int(round(v))
+            row[name] = v
+        if win_start_abs is not None and self.window is not None:
+            row["winStart"] = win_start_abs
+            row["winEnd"] = win_start_abs + self.window.size_ms
+        return self._postprocess(row)
+
+    def _drain_changes(self) -> list[dict[str, Any]]:
+        self.state, n, kidx, win_start_rel, outs = \
+            self._extract_touched(self.state)
+        n = int(n)
+        if n == 0:
+            return []
+        kidx = np.asarray(kidx[:n])
+        win_start_rel = np.asarray(win_start_rel[:n])
+        outs_np = {k: np.asarray(v[:n]) for k, v in outs.items()}
+        rows = []
+        for i in range(n):
+            ws = (int(win_start_rel[i]) + self.epoch
+                  if self.window is not None else None)
+            row = self._agg_row(int(kidx[i]), outs_np, i, ws)
+            if row is not None:
+                rows.append(row)
+        return rows
+
+    def close_due_windows(self) -> list[dict[str, Any]]:
+        """Extract + reset every open window past end+grace. Host-driven."""
+        if self.window is None or self.watermark_abs < 0:
+            return []
+        w = self.window
+        due = [s for s in self._open
+               if s + w.size_ms + w.grace_ms <= self.watermark_abs]
+        rows: list[dict[str, Any]] = []
+        for s in sorted(due):
+            ow = self._open.pop(s)
+            if not self.emit_changes:
+                rows.extend(self._extract_window_rows(ow))
+            self.state = self._reset_slot(self.state, np.int32(ow.slot))
+        return rows
+
+    def _extract_window_rows(self, ow: _OpenWindow) -> list[dict[str, Any]]:
+        mask, _start_rel, outs = self._extract_slot(
+            self.state, np.int32(ow.slot))
+        mask = np.asarray(mask)
+        outs_np = {k: np.asarray(v) for k, v in outs.items()}
+        rows = []
+        for kid in np.nonzero(mask)[0]:
+            row = self._agg_row(int(kid), outs_np, int(kid), ow.start_abs)
+            if row is not None:
+                rows.append(row)
+        return rows
+
+    # ---- pull queries (materialized views) ---------------------------------
+
+    def peek(self) -> list[dict[str, Any]]:
+        """Current (open-window) aggregate rows without resetting state —
+        the live half of a materialized view; closed windows are kept by
+        the view store that owns this executor."""
+        rows: list[dict[str, Any]] = []
+        if self.window is None:
+            mask, _s, outs = self._extract_slot(self.state, np.int32(0))
+            mask = np.asarray(mask)
+            outs_np = {k: np.asarray(v) for k, v in outs.items()}
+            for kid in np.nonzero(mask)[0]:
+                row = self._agg_row(int(kid), outs_np, int(kid), None)
+                if row is not None:
+                    rows.append(row)
+            return rows
+        for s in sorted(self._open):
+            rows.extend(self._extract_window_rows(self._open[s]))
+        return rows
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.state)

@@ -1,0 +1,280 @@
+"""Scalar expressions: one AST, two evaluators.
+
+The reference interprets scalar expressions over Aeson JSON values per
+record (hstream-sql Internal/Codegen.hs:76-250, op enums AST.hs:87-105).
+Here the same AST is evaluated two ways:
+
+  * `compile_device(expr, ...)` -> a traced jnp function over columnar
+    batches, used for WHERE masks and aggregate inputs **inside the jitted
+    step** (numeric/boolean ops + dictionary-encoded string equality);
+  * `eval_host(expr, row)` -> Python-value interpreter with the full scalar
+    op set (strings, arrays, ifnull...), used for HAVING and SELECT
+    projections over emitted aggregate rows, which are tiny compared to
+    the ingest stream.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping
+
+import jax.numpy as jnp
+
+from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.engine.types import ColumnType, Schema, StringDictionary
+
+
+# ---- AST -------------------------------------------------------------------
+
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class Col(Expr):
+    name: str
+    stream: str | None = None  # qualified `stream.field` references
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    value: Any  # int | float | str | bool | None
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % = <> < <= > >= AND OR
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class UnOp(Expr):
+    op: str  # NOT NEG SIN COS ... STRLEN TO_UPPER ...
+    operand: Expr
+
+
+def columns_of(e: Expr) -> set[str]:
+    if isinstance(e, Col):
+        return {e.name}
+    if isinstance(e, BinOp):
+        return columns_of(e.left) | columns_of(e.right)
+    if isinstance(e, UnOp):
+        return columns_of(e.operand)
+    return set()
+
+
+# ---- device compilation ----------------------------------------------------
+
+_NUM_UNARY = {
+    "NEG": lambda x: -x,
+    "ABS": jnp.abs,
+    "CEIL": lambda x: jnp.ceil(x),
+    "FLOOR": lambda x: jnp.floor(x),
+    "ROUND": lambda x: jnp.round(x),
+    "SQRT": jnp.sqrt,
+    "SIGN": jnp.sign,
+    "SIN": jnp.sin, "COS": jnp.cos, "TAN": jnp.tan,
+    "ASIN": jnp.arcsin, "ACOS": jnp.arccos, "ATAN": jnp.arctan,
+    "SINH": jnp.sinh, "COSH": jnp.cosh, "TANH": jnp.tanh,
+    "ASINH": jnp.arcsinh, "ACOSH": jnp.arccosh, "ATANH": jnp.arctanh,
+    "LOG": jnp.log, "LOG2": jnp.log2, "LOG10": jnp.log10, "EXP": jnp.exp,
+}
+
+_CMP_OPS = {"=", "<>", "<", "<=", ">", ">="}
+
+
+def _is_string_expr(e: Expr, schema: Schema) -> bool:
+    if isinstance(e, Col):
+        return schema.has(e.name) and schema.type_of(e.name) == ColumnType.STRING
+    if isinstance(e, Lit):
+        return isinstance(e.value, str)
+    return False
+
+
+def encode_strings(expr: Expr, schema: Schema,
+                   dicts: Mapping[str, StringDictionary]) -> Expr:
+    """Rewrite string-vs-column comparisons into dictionary-id comparisons.
+
+    Encoding the literal inserts it into the column's dictionary, so later
+    record values of the same string map to the same id. The resulting
+    expression is fully hashable and dictionary-free, which lets compiled
+    step functions be shared across executors (lru_cache in lattice.py)."""
+    if isinstance(expr, BinOp):
+        if expr.op in ("=", "<>") and (_is_string_expr(expr.left, schema)
+                                       or _is_string_expr(expr.right, schema)):
+            col_e, lit_e = ((expr.left, expr.right)
+                            if isinstance(expr.right, Lit)
+                            else (expr.right, expr.left))
+            if not isinstance(col_e, Col) or not isinstance(lit_e, Lit):
+                raise SQLCodegenError(
+                    "device string comparison must be column vs literal")
+            lit_id = dicts[col_e.name].encode(str(lit_e.value))
+            return BinOp(expr.op, col_e, Lit(lit_id))
+        return BinOp(expr.op, encode_strings(expr.left, schema, dicts),
+                     encode_strings(expr.right, schema, dicts))
+    if isinstance(expr, UnOp):
+        return UnOp(expr.op, encode_strings(expr.operand, schema, dicts))
+    return expr
+
+
+def compile_device(
+    expr: Expr,
+    schema: Schema,
+) -> Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]:
+    """Build cols->array function. String literals must be pre-encoded via
+    encode_strings; raises SQLCodegenError for host-only ops."""
+
+    def build(e: Expr):
+        if isinstance(e, Col):
+            name = e.name
+            if not schema.has(name):
+                raise SQLCodegenError(f"unknown column {name}")
+            return lambda cols: cols[name]
+        if isinstance(e, Lit):
+            v = e.value
+            if isinstance(v, str):
+                raise SQLCodegenError(
+                    "string literal not pre-encoded (see encode_strings)")
+            if v is None:
+                raise SQLCodegenError("NULL literal unsupported on device")
+            if isinstance(v, bool):
+                return lambda cols: jnp.asarray(v)
+            return lambda cols: jnp.asarray(v, dtype=jnp.float32
+                                            if isinstance(v, float) else jnp.int32)
+        if isinstance(e, BinOp):
+            op = e.op
+            lf, rf = build(e.left), build(e.right)
+            if op == "+":
+                return lambda cols: lf(cols) + rf(cols)
+            if op == "-":
+                return lambda cols: lf(cols) - rf(cols)
+            if op == "*":
+                return lambda cols: lf(cols) * rf(cols)
+            if op == "/":
+                return lambda cols: lf(cols) / rf(cols)
+            if op == "%":
+                return lambda cols: jnp.mod(lf(cols), rf(cols))
+            if op == "=":
+                return lambda cols: lf(cols) == rf(cols)
+            if op == "<>":
+                return lambda cols: lf(cols) != rf(cols)
+            if op == "<":
+                return lambda cols: lf(cols) < rf(cols)
+            if op == "<=":
+                return lambda cols: lf(cols) <= rf(cols)
+            if op == ">":
+                return lambda cols: lf(cols) > rf(cols)
+            if op == ">=":
+                return lambda cols: lf(cols) >= rf(cols)
+            if op == "AND":
+                return lambda cols: lf(cols) & rf(cols)
+            if op == "OR":
+                return lambda cols: lf(cols) | rf(cols)
+            raise SQLCodegenError(f"unsupported device op {op}")
+        if isinstance(e, UnOp):
+            if e.op == "NOT":
+                f = build(e.operand)
+                return lambda cols: ~f(cols)
+            fn = _NUM_UNARY.get(e.op)
+            if fn is None:
+                raise SQLCodegenError(f"op {e.op} is host-only")
+            f = build(e.operand)
+            return lambda cols: fn(f(cols))
+        raise SQLCodegenError(f"unknown expr {e!r}")
+
+    return build(expr)
+
+
+# ---- host interpreter ------------------------------------------------------
+
+_HOST_UNARY: dict[str, Callable[[Any], Any]] = {
+    "NEG": lambda x: -x,
+    "NOT": lambda x: not x,
+    "ABS": abs,
+    "CEIL": lambda x: math.ceil(x),
+    "FLOOR": lambda x: math.floor(x),
+    "ROUND": lambda x: round(x),
+    "SQRT": math.sqrt,
+    "SIGN": lambda x: (x > 0) - (x < 0),
+    "SIN": math.sin, "COS": math.cos, "TAN": math.tan,
+    "ASIN": math.asin, "ACOS": math.acos, "ATAN": math.atan,
+    "SINH": math.sinh, "COSH": math.cosh, "TANH": math.tanh,
+    "ASINH": math.asinh, "ACOSH": math.acosh, "ATANH": math.atanh,
+    "LOG": math.log, "LOG2": math.log2, "LOG10": math.log10, "EXP": math.exp,
+    "IS_INT": lambda x: isinstance(x, int) and not isinstance(x, bool),
+    "IS_FLOAT": lambda x: isinstance(x, float),
+    "IS_NUM": lambda x: isinstance(x, (int, float)) and not isinstance(x, bool),
+    "IS_BOOL": lambda x: isinstance(x, bool),
+    "IS_STR": lambda x: isinstance(x, str),
+    "IS_ARRAY": lambda x: isinstance(x, list),
+    "TO_STR": str,
+    "TO_UPPER": lambda x: str(x).upper(),
+    "TO_LOWER": lambda x: str(x).lower(),
+    "TRIM": lambda x: str(x).strip(),
+    "LTRIM": lambda x: str(x).lstrip(),
+    "RTRIM": lambda x: str(x).rstrip(),
+    "REVERSE": lambda x: x[::-1],
+    "STRLEN": len,
+    "ARR_DISTINCT": lambda x: list(dict.fromkeys(x)),
+    "ARR_LENGTH": len,
+    "ARR_MAX": max,
+    "ARR_MIN": min,
+    "ARR_SORT": sorted,
+    "ARR_SUM": sum,
+    "IFNULL_CHECK": lambda x: x,  # placeholder; IFNULL handled as BinOp
+}
+
+
+def eval_host(expr: Expr, row: Mapping[str, Any]) -> Any:
+    if isinstance(expr, Col):
+        key = f"{expr.stream}.{expr.name}" if expr.stream else expr.name
+        if key in row:
+            return row[key]
+        return row.get(expr.name)
+    if isinstance(expr, Lit):
+        return expr.value
+    if isinstance(expr, BinOp):
+        op = expr.op
+        if op == "AND":
+            return bool(eval_host(expr.left, row)) and bool(eval_host(expr.right, row))
+        if op == "OR":
+            return bool(eval_host(expr.left, row)) or bool(eval_host(expr.right, row))
+        if op == "IFNULL":
+            v = eval_host(expr.left, row)
+            return eval_host(expr.right, row) if v is None else v
+        l, r = eval_host(expr.left, row), eval_host(expr.right, row)
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        if op == "%":
+            return l % r
+        if op == "=":
+            return l == r
+        if op == "<>":
+            return l != r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        if op == "ARR_CONTAINS":
+            return r in l
+        if op == "ARR_JOIN":
+            return str(r).join(str(x) for x in l)
+        raise SQLCodegenError(f"unsupported host op {op}")
+    if isinstance(expr, UnOp):
+        fn = _HOST_UNARY.get(expr.op)
+        if fn is None:
+            raise SQLCodegenError(f"unsupported host op {expr.op}")
+        return fn(eval_host(expr.operand, row))
+    raise SQLCodegenError(f"unknown expr {expr!r}")

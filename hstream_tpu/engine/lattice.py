@@ -1,0 +1,375 @@
+"""The window-state lattice: device state + the jitted micro-batch step.
+
+This is the hot path. The reference's equivalent is the per-record
+aggregate processor (hstream-processing TimeWindowedStream.hs:82-103: per
+record compute `windowsFor ts`, drop if past grace, get/agg/put a KV store
+keyed by serialized (key, window)). Here the same semantics are one fused
+scatter pass over a dense state lattice:
+
+    state[plane][key_id, slot, ...]     slot = (win_start // advance) % W
+
+W (hstream_tpu.engine.window.num_slots) covers every window that can still
+receive records given the grace period, so a slot is always closed and
+reset by the host watermark loop before it could be reused — `slot_start`
+tracks the window start currently occupying each slot.
+
+Late records (win_end + grace <= watermark, the reference's
+`observedStreamTime` check at TimeWindowedStream.hs:92) are masked out and
+scattered to a dropped out-of-bounds row (`mode="drop"`).
+
+All accumulator updates are commutative monoid ops (add / min / max /
+register-max / bin-add), so partial lattices from different chips merge
+exactly — the basis for the data-parallel sharding in hstream_tpu.parallel.
+
+Watermark lives on the HOST, not in device state: the step function is a
+pure scatter-aggregation with no device->host sync; the host decides when
+to call extract/reset for closed slots (rare, off the hot path).
+
+Device time is int32 ms relative to a per-query epoch; `rebase` shifts
+`slot_start` when the host re-anchors the epoch.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Mapping, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hstream_tpu.engine.plan import AggKind, AggSpec
+from hstream_tpu.engine.sketches import (
+    HLLConfig,
+    QuantileConfig,
+    hll_estimate,
+    hll_update_indices,
+    quantile_bin,
+    quantile_estimate,
+)
+from hstream_tpu.engine.window import FixedWindow, num_slots
+
+NEG_INF = jnp.float32(-jnp.inf)
+POS_INF = jnp.float32(jnp.inf)
+EMPTY_START = -(1 << 31)  # slot_start sentinel for "slot unoccupied"
+
+
+@dataclass(frozen=True)
+class LatticeSpec:
+    """Static configuration the step function is specialized on."""
+
+    n_keys: int
+    window: FixedWindow | None          # None = windowless global group-by
+    aggs: tuple[AggSpec, ...]
+    hll: HLLConfig = HLLConfig()
+    qcfg: QuantileConfig = QuantileConfig()
+
+    @property
+    def n_slots(self) -> int:
+        return 1 if self.window is None else num_slots(self.window)
+
+    @property
+    def windows_per_record(self) -> int:
+        return 1 if self.window is None else self.window.windows_per_record
+
+
+def _plane_name(i: int, agg: AggSpec) -> str:
+    return f"a{i}_{agg.kind.value}"
+
+
+def init_state(spec: LatticeSpec) -> dict[str, jnp.ndarray]:
+    K, W = spec.n_keys, spec.n_slots
+    state: dict[str, jnp.ndarray] = {
+        "count": jnp.zeros((K, W), jnp.int32),
+        "slot_start": jnp.full((W,), EMPTY_START, jnp.int32),
+        "touched": jnp.zeros((K, W), jnp.bool_),
+    }
+    for i, agg in enumerate(spec.aggs):
+        name = _plane_name(i, agg)
+        if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+            state[name] = jnp.zeros((K, W), jnp.int32)
+        elif agg.kind == AggKind.SUM:
+            state[name] = jnp.zeros((K, W), jnp.float32)
+        elif agg.kind == AggKind.AVG:
+            state[name] = jnp.zeros((K, W), jnp.float32)
+            state[name + "_n"] = jnp.zeros((K, W), jnp.int32)  # non-null count
+        elif agg.kind == AggKind.MIN:
+            state[name] = jnp.full((K, W), POS_INF, jnp.float32)
+        elif agg.kind == AggKind.MAX:
+            state[name] = jnp.full((K, W), NEG_INF, jnp.float32)
+        elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+            state[name] = jnp.zeros((K, W, spec.hll.m), jnp.int8)
+        elif agg.kind == AggKind.APPROX_QUANTILE:
+            state[name] = jnp.zeros((K, W, spec.qcfg.n_bins), jnp.int32)
+        else:
+            raise NotImplementedError(f"agg {agg.kind}")
+    return state
+
+
+ValueFn = Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
+
+# per-agg input: (value fn | None for COUNT(*), null-mask column key | None)
+AggInput = tuple[ValueFn | None, str | None]
+
+
+def build_step(spec: LatticeSpec,
+               agg_inputs: list[AggInput],
+               filter_fn: ValueFn | None = None):
+    """Compile the micro-batch step.
+
+    step(state, watermark, key_ids i32[B], ts i32[B], valid bool[B],
+         cols {name: [B]}) -> state'
+
+    `agg_inputs[i]` is (value_fn, null_key): value_fn computes agg i's
+    input column (None for COUNT(*)); null_key names a bool column in
+    `cols` that is True where the input is SQL NULL (missing field).
+    NULL and non-finite inputs do not contribute to COUNT(col) / SUM /
+    AVG / MIN / MAX / sketches, matching SQL aggregate semantics.
+    `filter_fn` is the WHERE mask. All are traced into the same jit.
+    """
+    K, W = spec.n_keys, spec.n_slots
+    n_per = spec.windows_per_record
+    win = spec.window
+
+    @jax.jit
+    def step(state, watermark, key_ids, ts, valid, cols):
+        if filter_fn is not None:
+            valid = valid & filter_fn(cols)
+
+        if win is None:
+            starts = jnp.zeros((key_ids.shape[0], 1), jnp.int32)
+            ok = valid[:, None]
+            slots = jnp.zeros_like(starts)
+        else:
+            advance, size, grace = win.advance_ms, win.size_ms, win.grace_ms
+            latest = ts - jnp.mod(ts, advance)
+            offs = (jnp.arange(n_per, dtype=jnp.int32) * advance)[None, :]
+            starts = latest[:, None] - offs                     # [B, n_per]
+            late = (starts + (size + grace)) <= watermark
+            ok = valid[:, None] & ~late & (starts >= 0)
+            slots = jnp.mod(starts // advance, W)
+
+        flat_k = jnp.where(ok, key_ids[:, None], K).reshape(-1)  # K = OOB -> drop
+        flat_s = jnp.where(ok, slots, 0).reshape(-1)
+        flat_ok = ok.reshape(-1)
+        flat_starts = starts.reshape(-1)
+
+        out = dict(state)
+        out["count"] = state["count"].at[flat_k, flat_s].add(
+            flat_ok.astype(jnp.int32), mode="drop")
+        out["slot_start"] = state["slot_start"].at[
+            jnp.where(flat_ok, flat_s, W)].max(flat_starts, mode="drop")
+        out["touched"] = state["touched"].at[flat_k, flat_s].set(
+            True, mode="drop")
+
+        for i, agg in enumerate(spec.aggs):
+            name = _plane_name(i, agg)
+            vfn, null_key = agg_inputs[i]
+            if agg.kind == AggKind.COUNT_ALL:
+                out[name] = state[name].at[flat_k, flat_s].add(
+                    flat_ok.astype(jnp.int32), mode="drop")
+                continue
+            v = vfn(cols)                                        # [B]
+            # input validity: not SQL NULL, and finite for float inputs
+            input_ok = jnp.ones(v.shape, jnp.bool_)
+            if null_key is not None:
+                input_ok = input_ok & ~cols[null_key]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                input_ok = input_ok & jnp.isfinite(v)
+            iok = flat_ok & jnp.repeat(input_ok, n_per)
+            v_rep = jnp.repeat(v, n_per)
+            if agg.kind == AggKind.COUNT:
+                out[name] = state[name].at[flat_k, flat_s].add(
+                    iok.astype(jnp.int32), mode="drop")
+            elif agg.kind == AggKind.SUM:
+                vals = jnp.where(iok, v_rep.astype(jnp.float32), 0.0)
+                out[name] = state[name].at[flat_k, flat_s].add(vals, mode="drop")
+            elif agg.kind == AggKind.AVG:
+                vals = jnp.where(iok, v_rep.astype(jnp.float32), 0.0)
+                out[name] = state[name].at[flat_k, flat_s].add(vals, mode="drop")
+                out[name + "_n"] = state[name + "_n"].at[flat_k, flat_s].add(
+                    iok.astype(jnp.int32), mode="drop")
+            elif agg.kind == AggKind.MIN:
+                vals = jnp.where(iok, v_rep.astype(jnp.float32), POS_INF)
+                out[name] = state[name].at[flat_k, flat_s].min(vals, mode="drop")
+            elif agg.kind == AggKind.MAX:
+                vals = jnp.where(iok, v_rep.astype(jnp.float32), NEG_INF)
+                out[name] = state[name].at[flat_k, flat_s].max(vals, mode="drop")
+            elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+                reg, rank = hll_update_indices(v, spec.hll)
+                reg_rep = jnp.repeat(reg, n_per)
+                rank_rep = jnp.where(iok, jnp.repeat(rank, n_per), 0)
+                out[name] = state[name].at[flat_k, flat_s, reg_rep].max(
+                    rank_rep, mode="drop")
+            elif agg.kind == AggKind.APPROX_QUANTILE:
+                b_rep = jnp.repeat(quantile_bin(v, spec.qcfg), n_per)
+                out[name] = state[name].at[flat_k, flat_s, b_rep].add(
+                    iok.astype(jnp.int32), mode="drop")
+            else:
+                raise NotImplementedError(agg.kind)
+        return out
+
+    return step
+
+
+def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
+    """Finalize one slot column {plane: [K, ...]} -> {out_name: [K] f32}."""
+    outs = {}
+    for i, agg in enumerate(spec.aggs):
+        name = _plane_name(i, agg)
+        if agg.kind == AggKind.AVG:
+            denom = jnp.maximum(state_col[name + "_n"].astype(jnp.float32), 1.0)
+            outs[agg.out_name] = state_col[name] / denom
+        elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+            outs[agg.out_name] = hll_estimate(state_col[name], spec.hll)
+        elif agg.kind == AggKind.APPROX_QUANTILE:
+            outs[agg.out_name] = quantile_estimate(
+                state_col[name], agg.quantile or 0.5, spec.qcfg)
+        elif agg.kind == AggKind.MIN:
+            outs[agg.out_name] = jnp.where(
+                state_col["count"] > 0, state_col[name], 0.0)
+        elif agg.kind == AggKind.MAX:
+            outs[agg.out_name] = jnp.where(
+                state_col["count"] > 0, state_col[name], 0.0)
+        else:
+            outs[agg.out_name] = state_col[name].astype(jnp.float32)
+    return outs
+
+
+def build_extract_slot(spec: LatticeSpec):
+    """extract(state, slot) -> (mask [K], win_start scalar, {name: [K]}).
+
+    Finalized values for one slot column; called by the host when the
+    watermark closes a window. Off the hot path."""
+
+    @jax.jit
+    def extract(state, slot):
+        col = {k: v[:, slot] for k, v in state.items()
+               if k not in ("slot_start", "touched")}
+        outs = finalize_column(spec, col)
+        mask = col["count"] > 0
+        return mask, state["slot_start"][slot], outs
+
+    return extract
+
+
+def build_reset_slot(spec: LatticeSpec):
+    @jax.jit
+    def reset(state, slot):
+        out = dict(state)
+        for i, agg in enumerate(spec.aggs):
+            name = _plane_name(i, agg)
+            out[name] = state[name].at[:, slot].set(init_value(agg))
+            if agg.kind == AggKind.AVG:
+                out[name + "_n"] = state[name + "_n"].at[:, slot].set(0)
+        out["count"] = state["count"].at[:, slot].set(0)
+        out["touched"] = state["touched"].at[:, slot].set(False)
+        out["slot_start"] = state["slot_start"].at[slot].set(EMPTY_START)
+        return out
+
+    return reset
+
+
+def init_value(agg: AggSpec):
+    if agg.kind == AggKind.MIN:
+        return POS_INF
+    if agg.kind == AggKind.MAX:
+        return NEG_INF
+    return 0
+
+
+def build_extract_touched(spec: LatticeSpec, max_out: int):
+    """Changelog extraction for EMIT CHANGES: all (key, window) pairs
+    touched since the last call, with finalized current values.
+
+    extract(state) -> (state with touched cleared,
+                       n scalar, key_idx [E], win_start [E], {name: [E]})
+
+    Deviation from the reference (documented): the reference emits one
+    change per input record (TimeWindowedStream.hs:101); a batched engine
+    emits one change per touched (key, window) per micro-batch."""
+
+    @jax.jit
+    def extract(state):
+        mask = state["touched"]
+        n = jnp.sum(mask.astype(jnp.int32))
+        kidx, sidx = jnp.nonzero(mask, size=max_out, fill_value=0)
+        valid = jnp.arange(max_out) < n
+        col = {k: v[kidx, sidx] for k, v in state.items()
+               if k not in ("slot_start", "touched")}
+        outs = finalize_column(spec, col)
+        win_start = state["slot_start"][sidx]
+        out_state = dict(state)
+        out_state["touched"] = jnp.zeros_like(mask)
+        return out_state, n, kidx, jnp.where(valid, win_start, 0), outs
+
+    return extract
+
+
+class CompiledLattice(NamedTuple):
+    step: Callable
+    extract_slot: Callable
+    reset_slot: Callable
+    extract_touched: Callable
+    null_keys: tuple[str | None, ...]  # per agg: the __null_a{i} cols key
+
+
+@functools.lru_cache(maxsize=512)
+def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int
+             ) -> CompiledLattice:
+    """Shared, cached compilation of all lattice functions for a given
+    (spec, schema, filter) — executors with identical shapes reuse the same
+    jitted callables (and therefore the same XLA executables). Requires
+    expressions with string literals pre-encoded (expr.encode_strings)."""
+    from hstream_tpu.engine.expr import compile_device
+
+    agg_inputs: list[AggInput] = []
+    null_keys: list[str | None] = []
+    for i, agg in enumerate(spec.aggs):
+        if agg.input is None:
+            agg_inputs.append((None, None))
+            null_keys.append(None)
+        else:
+            key = f"__null_a{i}"
+            agg_inputs.append((compile_device(agg.input, schema), key))
+            null_keys.append(key)
+    filter_fn = compile_device(filter_expr, schema) if filter_expr is not None \
+        else None
+    return CompiledLattice(
+        step=build_step(spec, agg_inputs, filter_fn),
+        extract_slot=build_extract_slot(spec),
+        reset_slot=build_reset_slot(spec),
+        extract_touched=build_extract_touched(spec, max_out),
+        null_keys=tuple(null_keys),
+    )
+
+
+@jax.jit
+def rebase(state, delta):
+    """Shift device-relative time by -delta (host re-anchored the epoch)."""
+    out = dict(state)
+    occupied = state["slot_start"] != EMPTY_START
+    out["slot_start"] = jnp.where(
+        occupied, state["slot_start"] - delta, state["slot_start"])
+    return out
+
+
+def grow_keys(state: dict[str, jnp.ndarray], spec: LatticeSpec,
+              new_n_keys: int) -> dict[str, jnp.ndarray]:
+    """Pad every keyed plane from K to new_n_keys (host, rare)."""
+    old = spec.n_keys
+    extra = new_n_keys - old
+    out = {}
+    for k, v in state.items():
+        if k == "slot_start":
+            out[k] = v
+            continue
+        pad_width = [(0, extra)] + [(0, 0)] * (v.ndim - 1)
+        if k.endswith("_min"):
+            out[k] = jnp.pad(v, pad_width, constant_values=np.float32(np.inf))
+        elif k.endswith("_max"):
+            out[k] = jnp.pad(v, pad_width, constant_values=np.float32(-np.inf))
+        else:
+            out[k] = jnp.pad(v, pad_width)
+    return out

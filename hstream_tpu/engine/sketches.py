@@ -1,0 +1,160 @@
+"""Streaming sketches as pure JAX ops over the state lattice.
+
+Both sketches are commutative monoids, which is what makes the whole
+engine data-parallel: per-chip partial sketches merge with an elementwise
+max / add collective at window close.
+
+* HyperLogLog (APPROX_COUNT_DISTINCT): registers int8 [..., m], m = 2^p.
+  Update = scatter-max of the leading-zero rank of a 32-bit hash; estimate
+  uses the standard bias-corrected harmonic mean with the linear-counting
+  small-range correction.
+* Log-binned histogram (APPROX_QUANTILE, DDSketch-flavored): int32 counts
+  over geometric value buckets; quantiles read off the bucket CDF with a
+  known relative error set by the bucket growth factor gamma.
+
+The reference declares these capabilities at the SQL surface (AST.hs
+aggregates; BASELINE configs 3-4) — there they would run per record on the
+CPU; here they are batched scatter ops that XLA fuses into the same kernel
+pass as the other accumulators.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---- 32-bit hashing (device) ----------------------------------------------
+
+_U32 = jnp.uint32
+
+
+def _mix32(h):
+    """murmur3 finalizer: a fast avalanche over uint32."""
+    h = h.astype(_U32)
+    h = h ^ (h >> 16)
+    h = h * _U32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * _U32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash_u32(values: jnp.ndarray) -> jnp.ndarray:
+    """Hash a float32/int32/bool column to uint32."""
+    if values.dtype == jnp.float32:
+        # canonicalize -0.0 == 0.0 before bitcasting
+        values = jnp.where(values == 0.0, 0.0, values)
+        bits = jax.lax.bitcast_convert_type(values, jnp.uint32)
+    else:
+        bits = values.astype(jnp.int32).astype(_U32)
+    return _mix32(bits)
+
+
+def clz32(x: jnp.ndarray) -> jnp.ndarray:
+    """Count leading zeros of uint32, branch-free."""
+    x = x.astype(_U32)
+    n = jnp.zeros(x.shape, dtype=jnp.int32)
+    for shift in (16, 8, 4, 2, 1):
+        hi_empty = (x >> (32 - shift)) == 0  # top `shift` bits all zero
+        n = n + jnp.where(hi_empty, shift, 0)
+        x = jnp.where(hi_empty, x << shift, x)
+    return jnp.where(x == 0, 32, n)
+
+
+# ---- HyperLogLog -----------------------------------------------------------
+
+@dataclass(frozen=True)
+class HLLConfig:
+    precision: int = 10  # m = 1024 registers, ~3.2% standard error
+
+    @property
+    def m(self) -> int:
+        return 1 << self.precision
+
+
+def hll_update_indices(values: jnp.ndarray, cfg: HLLConfig):
+    """Per-record (register index, rank) for scatter-max into registers."""
+    h = hash_u32(values)
+    p = cfg.precision
+    reg = (h >> (32 - p)).astype(jnp.int32)
+    w = (h << p).astype(_U32)  # remaining 32-p bits, left-aligned
+    rank = jnp.minimum(clz32(w) + 1, 32 - p + 1).astype(jnp.int8)
+    return reg, rank
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1 + 1.079 / m)
+
+
+def hll_estimate(registers: jnp.ndarray, cfg: HLLConfig) -> jnp.ndarray:
+    """Estimate cardinality from int8 registers [..., m] -> float32 [...]."""
+    m = cfg.m
+    regs = registers.astype(jnp.float32)
+    raw = _alpha(m) * m * m / jnp.sum(jnp.exp2(-regs), axis=-1)
+    zeros = jnp.sum(registers == 0, axis=-1).astype(jnp.float32)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    use_linear = (raw <= 2.5 * m) & (zeros > 0)
+    return jnp.where(use_linear, linear, raw)
+
+
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(a, b)
+
+
+# ---- log-binned quantile histogram ----------------------------------------
+
+@dataclass(frozen=True)
+class QuantileConfig:
+    """Geometric buckets over [min_value, max_value]; values below
+    min_value (incl. zero/negatives) land in bucket 0."""
+
+    n_bins: int = 512
+    min_value: float = 1e-6
+    max_value: float = 1e9
+
+    @property
+    def gamma_log(self) -> float:
+        return math.log(self.max_value / self.min_value) / (self.n_bins - 1)
+
+
+def quantile_bin(values: jnp.ndarray, cfg: QuantileConfig) -> jnp.ndarray:
+    """Bucket index int32 [...] for float values."""
+    v = jnp.maximum(values.astype(jnp.float32), 0.0)
+    safe = jnp.maximum(v, cfg.min_value)
+    b = jnp.floor(jnp.log(safe / cfg.min_value) / cfg.gamma_log).astype(jnp.int32) + 1
+    b = jnp.clip(b, 1, cfg.n_bins - 1)
+    return jnp.where(v < cfg.min_value, 0, b)
+
+
+def quantile_estimate(hist: jnp.ndarray, q: float,
+                      cfg: QuantileConfig) -> jnp.ndarray:
+    """q-quantile from histogram counts [..., n_bins] -> float32 [...].
+
+    Returns each bucket's geometric midpoint; relative error is bounded by
+    the bucket width."""
+    counts = hist.astype(jnp.float32)
+    total = jnp.sum(counts, axis=-1, keepdims=True)
+    cdf = jnp.cumsum(counts, axis=-1)
+    target = q * jnp.maximum(total, 1.0)
+    # first bucket whose cdf >= target
+    idx = jnp.sum((cdf < target).astype(jnp.int32), axis=-1)
+    idx = jnp.clip(idx, 0, cfg.n_bins - 1)
+    # geometric midpoint of bucket idx (bucket 0 -> ~0)
+    log_lo = (idx.astype(jnp.float32) - 1.0) * cfg.gamma_log
+    mid = cfg.min_value * jnp.exp(log_lo + 0.5 * cfg.gamma_log)
+    return jnp.where(idx == 0, 0.0, mid)
+
+
+def np_quantile_reference(values: "np.ndarray", q: float) -> float:
+    """Exact quantile for tests."""
+    return float(np.quantile(np.asarray(values, dtype=np.float64), q))

@@ -1,0 +1,10 @@
+"""Generated protobuf messages for the HStreamApi surface.
+
+`api_pb2` is generated from `api.proto` by `protoc --python_out`; the
+generated file is checked in so tests do not require protoc. Regenerate
+with:  protoc --python_out=hstream_tpu/proto --proto_path=hstream_tpu/proto api.proto
+"""
+
+from hstream_tpu.proto import api_pb2
+
+__all__ = ["api_pb2"]

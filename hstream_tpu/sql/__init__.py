@@ -1,0 +1,23 @@
+"""SQL frontend: lexer -> parser -> validate -> refine -> plan.
+
+Covers the reference's SQL surface (hstream-sql/etc/SQL.cf): SELECT with
+EMIT CHANGES, windows TUMBLING/HOPPING/SESSION, INNER/LEFT/OUTER JOIN
+WITHIN, CREATE STREAM [AS] / CREATE VIEW / CREATE SINK CONNECTOR,
+INSERT (fields / JSON / binary), SHOW / DROP / TERMINATE / EXPLAIN, the
+scalar function library, and pull queries against views (SelectView).
+Extensions: APPROX_COUNT_DISTINCT and APPROX_QUANTILE aggregates backed
+by the engine's sketch kernels.
+
+The pipeline mirrors the reference's parse -> validate -> refine
+(Parse.hs:19-30) but is a hand-written Pratt/recursive-descent parser
+instead of generated BNFC tables, and codegen lowers to the engine's
+logical plan rather than processor closures (Codegen.hs:94-105 plan ADT).
+"""
+
+from hstream_tpu.sql.parser import parse
+from hstream_tpu.sql.refine import refine, parse_and_refine
+from hstream_tpu.sql.codegen import stream_codegen, Plan
+from hstream_tpu.sql import plans
+
+__all__ = ["parse", "refine", "parse_and_refine", "stream_codegen", "Plan",
+           "plans"]

@@ -1,0 +1,51 @@
+from hstream_tpu.store.api import (
+    LSN_MIN,
+    LSN_MAX,
+    Compression,
+    DataBatch,
+    GapRecord,
+    GapType,
+    LogAttrs,
+    LogStore,
+    LogReader,
+    CheckpointStore,
+)
+from hstream_tpu.store.memstore import MemLogStore
+from hstream_tpu.store.streams import StreamApi, StreamType
+from hstream_tpu.store.checkpoint import (
+    MemCheckpointStore,
+    FileCheckpointStore,
+    LogCheckpointStore,
+    CheckpointedReader,
+)
+
+__all__ = [
+    "LSN_MIN",
+    "LSN_MAX",
+    "Compression",
+    "DataBatch",
+    "GapRecord",
+    "GapType",
+    "LogAttrs",
+    "LogStore",
+    "LogReader",
+    "CheckpointStore",
+    "MemLogStore",
+    "StreamApi",
+    "StreamType",
+    "MemCheckpointStore",
+    "FileCheckpointStore",
+    "LogCheckpointStore",
+    "CheckpointedReader",
+]
+
+
+def open_store(uri: str | None = None) -> LogStore:
+    """Open a log store. `None` or "mem://" gives the in-memory backend;
+    "file:///path" (or a bare path) opens the native embedded store."""
+    if uri is None or uri == "mem://":
+        return MemLogStore()
+    path = uri[len("file://"):] if uri.startswith("file://") else uri
+    from hstream_tpu.store.native import NativeLogStore
+
+    return NativeLogStore(path)

@@ -1,0 +1,40 @@
+from hstream_tpu.common import (
+    build_record,
+    flatten_json,
+    gen_unique,
+    parse_record,
+    record_to_dict,
+)
+from hstream_tpu.proto import api_pb2 as pb
+
+
+def test_record_json_roundtrip():
+    rec = build_record({"temp": 25, "name": "dev1", "ok": True, "x": 1.5},
+                       key="k1", attributes={"a": "b"})
+    data = rec.SerializeToString()
+    back = parse_record(data)
+    assert back.header.flag == pb.RECORD_FLAG_JSON
+    assert back.header.key == "k1"
+    assert back.header.attributes["a"] == "b"
+    assert back.header.publish_time_ms > 0
+    d = record_to_dict(back)
+    assert d == {"temp": 25, "name": "dev1", "ok": True, "x": 1.5}
+    assert isinstance(d["temp"], int)  # integral floats decode to int
+
+
+def test_record_raw():
+    rec = build_record(b"\x00\x01binary")
+    assert rec.header.flag == pb.RECORD_FLAG_RAW
+    assert record_to_dict(rec) is None
+    assert rec.payload == b"\x00\x01binary"
+
+
+def test_flatten_json():
+    assert flatten_json({"a": {"b": {"c": 1}, "d": 2}, "e": [1, 2]}) == {
+        "a.b.c": 1, "a.d": 2, "e": [1, 2]}
+
+
+def test_gen_unique():
+    ids = [gen_unique() for _ in range(1000)]
+    assert len(set(ids)) == 1000
+    assert all(i > 0 for i in ids)

@@ -1,0 +1,323 @@
+"""Engine semantics tests against hand-computed expectations.
+
+Window/grace/late-record semantics follow the reference
+(TimeWindowedStream.hs windowsFor / grace drop), checked here on the CPU
+backend with tiny shapes.
+"""
+
+import numpy as np
+import pytest
+
+from hstream_tpu.engine import (
+    AggKind,
+    AggSpec,
+    AggregateNode,
+    ColumnType,
+    FilterNode,
+    QueryExecutor,
+    Schema,
+    SourceNode,
+    TumblingWindow,
+    HoppingWindow,
+)
+from hstream_tpu.engine.expr import BinOp, Col, Lit
+
+SCHEMA = Schema.of(device=ColumnType.STRING, temp=ColumnType.FLOAT,
+                   humidity=ColumnType.FLOAT)
+
+BASE = 1_700_000_000_000  # absolute ms
+
+
+def source():
+    return SourceNode(stream="s", schema=SCHEMA)
+
+
+def make_exec(aggs, window, *, where=None, group=("device",),
+              emit_changes=False, having=None, post=None):
+    child = source() if where is None else FilterNode(source(), where)
+    node = AggregateNode(
+        child=child,
+        group_keys=[Col(g) for g in group],
+        window=window,
+        aggs=list(aggs),
+        having=having,
+        post_projections=post or [],
+    )
+    return QueryExecutor(node, SCHEMA, emit_changes=emit_changes,
+                         initial_keys=8, batch_capacity=256)
+
+
+def rows_of(*pairs):
+    """pairs of (device, temp, ts_offset_ms)"""
+    rows = [{"device": d, "temp": t} for d, t, _ in pairs]
+    ts = [BASE + off for _, _, off in pairs]
+    return rows, ts
+
+
+COUNT = AggSpec(AggKind.COUNT_ALL, "cnt")
+SUM_T = AggSpec(AggKind.SUM, "total", input=Col("temp"))
+
+
+def by_key(emitted):
+    return {(r["device"], r.get("winStart")): r for r in emitted}
+
+
+def test_tumbling_count_sum_close():
+    ex = make_exec([COUNT, SUM_T], TumblingWindow(10_000, grace_ms=0))
+    rows, ts = rows_of(("a", 1.0, 0), ("a", 2.0, 1000), ("b", 5.0, 2000),
+                       ("a", 3.0, 9999))
+    out = ex.process(rows, ts)
+    assert out == []  # nothing closed yet
+    # a record at +10s closes the first window
+    rows2, ts2 = rows_of(("b", 7.0, 10_500))
+    out2 = ex.process(rows2, ts2)
+    got = by_key(out2)
+    assert got[("a", BASE)]["cnt"] == 3
+    assert got[("a", BASE)]["total"] == pytest.approx(6.0)
+    assert got[("a", BASE)]["winEnd"] == BASE + 10_000
+    assert got[("b", BASE)]["cnt"] == 1
+    assert got[("b", BASE)]["total"] == pytest.approx(5.0)
+    assert len(out2) == 2
+
+
+def test_tumbling_emit_changes():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0),
+                   emit_changes=True)
+    rows, ts = rows_of(("a", 1.0, 0), ("a", 1.0, 100))
+    out = ex.process(rows, ts)
+    # batched changelog: one change per touched (key, window) per batch
+    assert len(out) == 1
+    assert out[0]["cnt"] == 2 and out[0]["device"] == "a"
+    out2 = ex.process(*rows_of(("a", 1.0, 200)))
+    assert out2[0]["cnt"] == 3
+
+
+def test_late_records_dropped():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0))
+    ex.process(*rows_of(("a", 1.0, 0)))
+    ex.process(*rows_of(("a", 1.0, 25_000)))  # watermark to 25s, closes w0
+    # record for window [0,10s) is now late; window [20s,30s) still open
+    out = ex.process(*rows_of(("a", 9.9, 5_000), ("a", 1.0, 21_000)))
+    assert out == []
+    out = ex.process(*rows_of(("a", 1.0, 30_000)))
+    got = by_key(out)
+    assert got[("a", BASE + 20_000)]["cnt"] == 2  # late record not counted
+    assert ("a", BASE) not in got
+
+
+def test_grace_keeps_late_window_open():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=5_000))
+    ex.process(*rows_of(("a", 1.0, 0)))
+    ex.process(*rows_of(("a", 1.0, 12_000)))  # within grace for w0
+    out = ex.process(*rows_of(("a", 1.0, 5_000)))  # late but in grace
+    assert out == []
+    out = ex.process(*rows_of(("a", 1.0, 15_100)))  # wm passes 10s+5s grace
+    got = by_key(out)
+    assert got[("a", BASE)]["cnt"] == 2
+
+
+def test_hopping_windows_multi_assign():
+    # HOP(size=20s, advance=10s): record at t=15s belongs to [0,20) and [10,30)
+    ex = make_exec([COUNT], HoppingWindow(20_000, 10_000, grace_ms=0))
+    ex.process(*rows_of(("a", 1.0, 15_000)))
+    out = ex.process(*rows_of(("a", 1.0, 45_000)))
+    got = by_key(out)
+    assert got[("a", BASE)]["cnt"] == 1
+    assert got[("a", BASE + 10_000)]["cnt"] == 1
+
+
+def test_min_max_avg():
+    aggs = [AggSpec(AggKind.MIN, "mn", input=Col("temp")),
+            AggSpec(AggKind.MAX, "mx", input=Col("temp")),
+            AggSpec(AggKind.AVG, "avg", input=Col("temp"))]
+    ex = make_exec(aggs, TumblingWindow(10_000, grace_ms=0))
+    ex.process(*rows_of(("a", 3.0, 0), ("a", -1.5, 100), ("a", 7.0, 200)))
+    out = ex.process(*rows_of(("a", 0.0, 11_000)))
+    r = by_key(out)[("a", BASE)]
+    assert r["mn"] == pytest.approx(-1.5)
+    assert r["mx"] == pytest.approx(7.0)
+    assert r["avg"] == pytest.approx((3.0 - 1.5 + 7.0) / 3)
+
+
+def test_where_filter_on_device():
+    where = BinOp(">", Col("temp"), Lit(0.0))
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0), where=where)
+    ex.process(*rows_of(("a", 5.0, 0), ("a", -5.0, 100), ("a", 1.0, 200)))
+    out = ex.process(*rows_of(("a", 1.0, 11_000)))
+    assert by_key(out)[("a", BASE)]["cnt"] == 2
+
+
+def test_string_equality_filter():
+    where = BinOp("=", Col("device"), Lit("a"))
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0), where=where,
+                   group=("device",))
+    ex.process(*rows_of(("a", 1.0, 0), ("b", 1.0, 100), ("a", 1.0, 200)))
+    out = ex.process(*rows_of(("b", 1.0, 11_000)))
+    got = by_key(out)
+    assert got[("a", BASE)]["cnt"] == 2
+    assert ("b", BASE) not in got
+
+
+def test_having_and_projection():
+    having = BinOp(">=", Col("cnt"), Lit(2))
+    post = [("device", Col("device")), ("doubled", BinOp("*", Col("cnt"), Lit(2)))]
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0),
+                   having=having, post=post)
+    ex.process(*rows_of(("a", 1.0, 0), ("a", 1.0, 100), ("b", 1.0, 200)))
+    out = ex.process(*rows_of(("b", 1.0, 11_000)))
+    assert len(out) == 1
+    assert out[0]["doubled"] == 4 and out[0]["device"] == "a"
+
+
+def test_approx_count_distinct():
+    aggs = [AggSpec(AggKind.APPROX_COUNT_DISTINCT, "uniq", input=Col("temp"))]
+    ex = make_exec(aggs, TumblingWindow(10_000, grace_ms=0))
+    n_distinct = 500
+    rows = [{"device": "a", "temp": float(i % n_distinct)} for i in range(2000)]
+    ts = [BASE + i for i in range(2000)]
+    ex.process(rows, ts)
+    out = ex.process(*rows_of(("a", 0.0, 11_000)))
+    uniq = by_key(out)[("a", BASE)]["uniq"]
+    assert abs(uniq - n_distinct) / n_distinct < 0.15
+
+
+def test_approx_quantile():
+    aggs = [AggSpec(AggKind.APPROX_QUANTILE, "p50", input=Col("temp"),
+                    quantile=0.5)]
+    ex = make_exec(aggs, TumblingWindow(10_000, grace_ms=0))
+    rng = np.random.default_rng(1)
+    vals = rng.lognormal(2.0, 1.0, size=5000)
+    rows = [{"device": "a", "temp": float(v)} for v in vals]
+    ts = [BASE + i for i in range(5000)]
+    ex.process(rows, ts)
+    out = ex.process(*rows_of(("a", 0.0, 11_000)))
+    p50 = by_key(out)[("a", BASE)]["p50"]
+    true = float(np.quantile(vals, 0.5))
+    assert abs(p50 - true) / true < 0.10
+
+
+def test_global_groupby_no_window():
+    ex = make_exec([COUNT, SUM_T], window=None, emit_changes=True)
+    out = ex.process(*rows_of(("a", 1.0, 0), ("b", 2.0, 50)))
+    got = {r["device"]: r for r in out}
+    assert got["a"]["cnt"] == 1 and got["b"]["total"] == pytest.approx(2.0)
+    assert "winStart" not in got["a"]
+    out2 = ex.process(*rows_of(("a", 3.0, 100)))
+    got2 = {r["device"]: r for r in out2}
+    assert got2["a"]["cnt"] == 2 and got2["a"]["total"] == pytest.approx(4.0)
+    assert "b" not in got2  # untouched keys not re-emitted
+
+
+def test_key_growth():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0))
+    rows = [{"device": f"d{i}", "temp": 1.0} for i in range(50)]  # > 8 keys
+    ts = [BASE + i for i in range(50)]
+    ex.process(rows, ts)
+    out = ex.process(*rows_of(("d0", 1.0, 11_000)))
+    assert len(out) == 50
+    assert all(r["cnt"] == 1 for r in out)
+
+
+def test_peek_live_state():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0))
+    closed = ex.process(*rows_of(("a", 1.0, 0), ("a", 1.0, 100),
+                                 ("b", 1.0, 12_000)))
+    # the watermark at +12s closed window [BASE, BASE+10s) during process
+    assert by_key(closed)[("a", BASE)]["cnt"] == 2
+    # peek shows the still-open window only
+    got = by_key(ex.peek())
+    assert got[("b", BASE + 10_000)]["cnt"] == 1
+    assert ("a", BASE) not in got
+
+
+def test_count_col_and_avg_skip_nulls():
+    aggs = [AggSpec(AggKind.COUNT, "c", input=Col("temp")),
+            AggSpec(AggKind.AVG, "avg", input=Col("temp")),
+            AggSpec(AggKind.COUNT_ALL, "call")]
+    ex = make_exec(aggs, TumblingWindow(10_000, grace_ms=0))
+    rows = [{"device": "a", "temp": 2.0}, {"device": "a"},  # temp missing
+            {"device": "a", "temp": 4.0}, {"device": "a", "temp": None}]
+    ts = [BASE + i for i in range(4)]
+    ex.process(rows, ts)
+    out = ex.process(*rows_of(("a", 0.0, 11_000)))
+    r = by_key(out)[("a", BASE)]
+    assert r["call"] == 4          # COUNT(*) counts all rows
+    assert r["c"] == 2             # COUNT(temp) skips nulls
+    assert r["avg"] == pytest.approx(3.0)  # AVG over non-null only
+
+
+def test_nan_does_not_poison_min_max():
+    aggs = [AggSpec(AggKind.MIN, "mn", input=Col("temp")),
+            AggSpec(AggKind.MAX, "mx", input=Col("temp")),
+            AggSpec(AggKind.SUM, "s", input=Col("temp"))]
+    ex = make_exec(aggs, TumblingWindow(10_000, grace_ms=0))
+    ex.process(*rows_of(("a", float("nan"), 0), ("a", 5.0, 100),
+                        ("a", float("inf"), 200)))
+    out = ex.process(*rows_of(("a", 0.0, 11_000)))
+    r = by_key(out)[("a", BASE)]
+    assert r["mn"] == 5.0 and r["mx"] == 5.0 and r["s"] == 5.0
+
+
+def test_hll_int_column_high_values():
+    # int inputs >= 2^24 must not collapse via a float32 cast
+    schema = Schema.of(device=ColumnType.STRING, uid=ColumnType.INT)
+    node = AggregateNode(
+        child=SourceNode("s", schema), group_keys=[Col("device")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.APPROX_COUNT_DISTINCT, "u", input=Col("uid"))])
+    ex = QueryExecutor(node, schema, emit_changes=False, initial_keys=8)
+    n = 2000
+    rows = [{"device": "a", "uid": (1 << 24) + i} for i in range(n)]
+    ex.process(rows, [BASE + i for i in range(n)])
+    out = ex.process([{"device": "a", "uid": 1}], [BASE + 11_000])
+    u = by_key(out)[("a", BASE)]["u"]
+    assert abs(u - n) / n < 0.15, u
+
+
+def test_rebase_preserves_open_windows():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=0))
+    ex.rebase_threshold = 40_000  # force a rebase quickly
+    ex.process(*rows_of(("a", 1.0, 0)))
+    ex.process(*rows_of(("a", 1.0, 50_000)))   # triggers rebase + closes w0
+    ex.process(*rows_of(("a", 1.0, 52_000)))   # same open window post-rebase
+    out = ex.process(*rows_of(("a", 1.0, 61_000)))
+    got = by_key(out)
+    assert got[("a", BASE + 50_000)]["cnt"] == 2
+
+
+def test_gap_split_preserves_in_grace_suffix_records():
+    # after a big stream gap, an in-grace out-of-order record in the same
+    # batch as the jump must still aggregate (the jump doesn't make it late)
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=60_000))
+    ex.process(*rows_of(("a", 1.0, 0)))
+    # big jump + an out-of-order record within grace of window [0,10s)
+    big = 500_000  # far beyond slot range, forces the split path
+    out = ex.process(*rows_of(("b", 1.0, big), ("a", 1.0, 5_000)))
+    assert out == []
+    out = ex.process(*rows_of(("a", 1.0, big + 80_000)))
+    got = by_key(out)
+    assert got[("a", BASE)]["cnt"] == 2  # both t=0 and t=5s records counted
+
+
+def test_nested_filters_all_applied():
+    from hstream_tpu.engine import FilterNode
+    inner = FilterNode(source(), BinOp(">", Col("temp"), Lit(0.0)))
+    outer = FilterNode(inner, BinOp("<", Col("temp"), Lit(10.0)))
+    node = AggregateNode(child=outer, group_keys=[Col("device")],
+                         window=TumblingWindow(10_000, grace_ms=0),
+                         aggs=[COUNT])
+    ex = QueryExecutor(node, SCHEMA, emit_changes=False, initial_keys=8)
+    ex.process(*rows_of(("a", -5.0, 0), ("a", 5.0, 100), ("a", 50.0, 200)))
+    out = ex.process(*rows_of(("a", 5.0, 11_000)))
+    assert by_key(out)[("a", BASE)]["cnt"] == 1
+
+
+def test_out_of_order_within_grace():
+    ex = make_exec([COUNT], TumblingWindow(10_000, grace_ms=20_000))
+    ex.process(*rows_of(("a", 1.0, 15_000)))
+    ex.process(*rows_of(("a", 1.0, 5_000)))   # out of order, within grace
+    ex.process(*rows_of(("a", 1.0, 8_000)))
+    out = ex.process(*rows_of(("a", 1.0, 40_100)))
+    got = by_key(out)
+    assert got[("a", BASE)]["cnt"] == 2
+    assert got[("a", BASE + 10_000)]["cnt"] == 1

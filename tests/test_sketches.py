@@ -1,0 +1,73 @@
+import numpy as np
+import jax.numpy as jnp
+
+from hstream_tpu.engine.sketches import (
+    HLLConfig,
+    QuantileConfig,
+    clz32,
+    hash_u32,
+    hll_estimate,
+    hll_update_indices,
+    quantile_bin,
+    quantile_estimate,
+)
+
+
+def test_clz32():
+    xs = jnp.array([0, 1, 2, 3, 0x80000000, 0xFFFFFFFF, 0x00010000],
+                   dtype=jnp.uint32)
+    expect = [32, 31, 30, 30, 0, 0, 15]
+    assert clz32(xs).tolist() == expect
+
+
+def test_hash_spread():
+    vals = jnp.arange(10_000, dtype=jnp.int32)
+    hs = np.asarray(hash_u32(vals))
+    assert len(np.unique(hs)) > 9_990  # essentially no collisions
+    # top byte should be roughly uniform
+    top = hs >> 24
+    counts = np.bincount(top, minlength=256)
+    assert counts.min() > 0
+
+
+def test_hll_accuracy():
+    cfg = HLLConfig(precision=10)
+    for true_n in (100, 5_000, 50_000):
+        vals = jnp.arange(true_n, dtype=jnp.float32)
+        reg, rank = hll_update_indices(vals, cfg)
+        registers = jnp.zeros((cfg.m,), jnp.int8).at[reg].max(rank)
+        est = float(hll_estimate(registers, cfg))
+        assert abs(est - true_n) / true_n < 0.15, (true_n, est)
+
+
+def test_hll_merge_equals_union():
+    cfg = HLLConfig(precision=10)
+    a_vals = jnp.arange(0, 3000, dtype=jnp.float32)
+    b_vals = jnp.arange(1500, 4500, dtype=jnp.float32)
+    def regs(vals):
+        reg, rank = hll_update_indices(vals, cfg)
+        return jnp.zeros((cfg.m,), jnp.int8).at[reg].max(rank)
+    merged = jnp.maximum(regs(a_vals), regs(b_vals))
+    union = regs(jnp.arange(0, 4500, dtype=jnp.float32))
+    assert float(hll_estimate(merged, cfg)) == float(hll_estimate(union, cfg))
+
+
+def test_quantile_accuracy():
+    cfg = QuantileConfig()
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=3.0, sigma=1.5, size=20_000).astype(np.float32)
+    bins = quantile_bin(jnp.asarray(vals), cfg)
+    hist = jnp.zeros((cfg.n_bins,), jnp.int32).at[bins].add(1)
+    for q in (0.5, 0.9, 0.99):
+        est = float(quantile_estimate(hist, q, cfg))
+        true = float(np.quantile(vals, q))
+        assert abs(est - true) / true < 0.10, (q, true, est)
+
+
+def test_quantile_zero_and_small():
+    cfg = QuantileConfig()
+    vals = jnp.asarray([0.0, 0.0, 1e-9], dtype=jnp.float32)
+    bins = quantile_bin(vals, cfg)
+    assert bins.tolist() == [0, 0, 0]
+    hist = jnp.zeros((cfg.n_bins,), jnp.int32).at[bins].add(1)
+    assert float(quantile_estimate(hist, 0.5, cfg)) == 0.0

@@ -1,0 +1,201 @@
+import threading
+import time
+
+import pytest
+
+from hstream_tpu.common.errors import LogNotFound, StreamExists, StreamNotFound
+from hstream_tpu.store import (
+    CheckpointedReader,
+    DataBatch,
+    FileCheckpointStore,
+    GapRecord,
+    GapType,
+    LogCheckpointStore,
+    MemCheckpointStore,
+    MemLogStore,
+    StreamApi,
+    StreamType,
+)
+
+
+@pytest.fixture
+def store():
+    return MemLogStore()
+
+
+def batches(results):
+    return [r for r in results if isinstance(r, DataBatch)]
+
+
+def test_append_read_roundtrip(store):
+    store.create_log(7)
+    lsn1 = store.append(7, b"one")
+    lsn2 = store.append_batch(7, [b"two", b"three"])
+    assert lsn2 > lsn1
+    reader = store.new_reader()
+    reader.set_timeout(0)
+    reader.start_reading(7)
+    out = reader.read(10)
+    assert [b.payloads for b in batches(out)] == [(b"one",), (b"two", b"three")]
+    assert out[0].lsn == lsn1 and out[1].lsn == lsn2
+    # nothing more to read
+    assert reader.read(10) == []
+
+
+def test_read_from_lsn_and_until(store):
+    store.create_log(1)
+    lsns = [store.append(1, f"r{i}".encode()) for i in range(5)]
+    reader = store.new_reader()
+    reader.set_timeout(0)
+    reader.start_reading(1, from_lsn=lsns[2], until_lsn=lsns[3])
+    out = batches(reader.read(10))
+    assert [b.payloads[0] for b in out] == [b"r2", b"r3"]
+    assert reader.read(10) == []
+
+
+def test_trim_surfaces_gap(store):
+    store.create_log(1)
+    lsns = [store.append(1, f"r{i}".encode()) for i in range(4)]
+    store.trim(1, lsns[1])
+    assert store.trim_point(1) == lsns[1]
+    reader = store.new_reader()
+    reader.set_timeout(0)
+    reader.start_reading(1)
+    out = reader.read(10)
+    assert isinstance(out[0], GapRecord)
+    assert out[0].gap_type == GapType.TRIM
+    assert out[0].hi_lsn == lsns[1]
+    assert [b.payloads[0] for b in batches(out)] == [b"r2", b"r3"]
+
+
+def test_blocking_read_wakes_on_append(store):
+    store.create_log(1)
+    reader = store.new_reader()
+    reader.set_timeout(5000)
+    reader.start_reading(1)
+    got = []
+
+    def consume():
+        got.extend(reader.read(10))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    store.append(1, b"wake")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert batches(got)[0].payloads == (b"wake",)
+
+
+def test_read_timeout(store):
+    store.create_log(1)
+    reader = store.new_reader()
+    reader.set_timeout(50)
+    reader.start_reading(1)
+    t0 = time.monotonic()
+    assert reader.read(10) == []
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_find_time_and_tail(store):
+    store.create_log(1)
+    assert store.is_log_empty(1)
+    lsn = store.append(1, b"x")
+    assert store.tail_lsn(1) == lsn
+    assert not store.is_log_empty(1)
+    assert store.find_time(1, 0) == lsn
+    assert store.find_time(1, int(time.time() * 1000) + 10_000) == lsn + 1
+
+
+def test_missing_log(store):
+    with pytest.raises(LogNotFound):
+        store.append(99, b"x")
+    reader = store.new_reader()
+    with pytest.raises(LogNotFound):
+        reader.start_reading(99)
+
+
+# ---- streams namespace ----
+
+def test_stream_api(store):
+    api = StreamApi(store)
+    logid = api.create_stream("s1", replication_factor=3)
+    assert api.stream_exists("s1")
+    assert api.get_logid("s1") == logid
+    assert api.stream_meta("s1")["replication_factor"] == 3
+    with pytest.raises(StreamExists):
+        api.create_stream("s1")
+    # distinct namespaces
+    vlogid = api.create_stream("s1", stream_type=StreamType.VIEW)
+    assert vlogid != logid
+    assert api.find_streams() == ["s1"]
+    assert api.find_streams(StreamType.VIEW) == ["s1"]
+    api.append("s1", b"data")
+    assert store.tail_lsn(logid) != 0
+    api.delete_stream("s1")
+    assert not api.stream_exists("s1")
+    with pytest.raises(StreamNotFound):
+        api.get_logid("s2")
+    # cache invalidated on delete
+    with pytest.raises(StreamNotFound):
+        api.get_logid("s1")
+
+
+# ---- checkpoint stores ----
+
+@pytest.mark.parametrize("make", [
+    lambda store, tmp_path: MemCheckpointStore(),
+    lambda store, tmp_path: FileCheckpointStore(str(tmp_path / "ckp.json")),
+    lambda store, tmp_path: LogCheckpointStore(store),
+])
+def test_checkpoint_store(store, tmp_path, make):
+    cs = make(store, tmp_path)
+    assert cs.get("c1", 1) is None
+    cs.update("c1", 1, 100)
+    cs.update_multi("c1", {2: 200, 3: 300})
+    cs.update("c2", 1, 999)
+    assert cs.get("c1", 1) == 100
+    assert cs.all_for("c1") == {1: 100, 2: 200, 3: 300}
+    cs.update("c1", 1, 150)
+    assert cs.get("c1", 1) == 150
+    cs.remove("c1")
+    assert cs.all_for("c1") == {}
+    assert cs.get("c2", 1) == 999
+
+
+def test_file_checkpoint_persistence(tmp_path):
+    path = str(tmp_path / "ckp.json")
+    cs = FileCheckpointStore(path)
+    cs.update("c1", 5, 42)
+    cs2 = FileCheckpointStore(path)
+    assert cs2.get("c1", 5) == 42
+
+
+def test_log_checkpoint_replay_and_compaction(store):
+    cs = LogCheckpointStore(store, compact_every=4)
+    for i in range(10):
+        cs.update("c1", 1, i)
+    cs.update("c2", 7, 70)
+    # fresh instance replays the log (incl. post-compaction snapshot)
+    cs2 = LogCheckpointStore(store)
+    assert cs2.get("c1", 1) == 9
+    assert cs2.get("c2", 7) == 70
+
+
+def test_checkpointed_reader(store):
+    api = StreamApi(store)
+    logid = api.create_stream("s")
+    lsns = [store.append(logid, f"r{i}".encode()) for i in range(5)]
+    cs = MemCheckpointStore()
+    r1 = CheckpointedReader("task-1", store.new_reader(), cs)
+    r1.set_timeout(0)
+    start = r1.start_reading_from_checkpoint(logid)
+    assert start == 1
+    out = batches(r1.read(3))
+    r1.write_checkpoints({logid: out[-1].lsn})
+    # resume from checkpoint
+    r2 = CheckpointedReader("task-1", store.new_reader(), cs)
+    r2.set_timeout(0)
+    r2.start_reading_from_checkpoint(logid)
+    out2 = batches(r2.read(10))
+    assert [b.payloads[0] for b in out2] == [b"r3", b"r4"]
