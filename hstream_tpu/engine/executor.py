@@ -132,6 +132,14 @@ class QueryExecutor:
         self.epoch: int | None = None        # absolute ms anchor, advance-aligned
         self.watermark_abs: int = -1
         self._open: dict[int, _OpenWindow] = {}  # start_abs -> window
+        # Window starts whose closure is deferred until the next process()
+        # call: populated by the gap-split path so a stream-time jump inside
+        # a batch cannot close (and emit) windows that records earlier in
+        # the same batch just aggregated into.
+        self._no_close: set[int] = set()
+        # window starts that received records during the current process()
+        # call (populated by _track_windows, cleared per call)
+        self._touched_this_call: set[int] = set()
         self.rebase_threshold = REBASE_THRESHOLD
 
     def _extract_filter(self) -> Expr | None:
@@ -155,30 +163,26 @@ class QueryExecutor:
 
     def _compile(self) -> None:
         n_per = self.spec.windows_per_record
+        self._layout = tuple(
+            (name, lattice.layout_tag(self.schema.type_of(name)))
+            for name in self._needed_cols)
         fns = lattice.compiled(self.spec, self.schema, self._filter_expr,
-                               self.batch_capacity * n_per)
+                               self.batch_capacity * n_per, self._layout)
         self._step = fns.step
         self._extract_slot = fns.extract_slot
         self._reset_slot = fns.reset_slot
         self._extract_touched = fns.extract_touched
-        self._agg_null_cols = {
-            key: sorted(columns_of(agg.input))
+        # per-agg null-ref columns in flag-bit order (non-None null keys)
+        self._null_refs = [
+            sorted(columns_of(agg.input))
             for key, agg in zip(fns.null_keys, self.spec.aggs)
             if key is not None
-        }
+        ]
 
     # ---- keys --------------------------------------------------------------
 
     def _key_id(self, row: Mapping[str, Any]) -> int:
-        key = tuple(row.get(c) for c in self.group_cols)
-        kid = self._key_ids.get(key)
-        if kid is None:
-            kid = len(self._key_rev)
-            if kid >= self.spec.n_keys:
-                self._grow_keys()
-            self._key_ids[key] = kid
-            self._key_rev.append(key)
-        return kid
+        return self.key_id_for(tuple(row.get(c) for c in self.group_cols))
 
     def _grow_keys(self) -> None:
         new_k = self.spec.n_keys * 2
@@ -235,46 +239,114 @@ class QueryExecutor:
         """Feed one micro-batch of decoded records; returns emitted rows."""
         if not rows:
             return []
+        try:
+            return self._process_batch(list(rows), list(ts_ms))
+        finally:
+            # deferred closes apply only within the call that deferred them
+            self._no_close.clear()
+            self._touched_this_call.clear()
+
+    def _new_window_starts(self, ts_ms: Sequence[int]) -> set[int]:
+        """Window starts this batch's records aggregate into (late ones
+        — already past end+grace at the current watermark — excluded,
+        matching the device mask). Vectorized: cost scales with distinct
+        aligned timestamps, not records."""
+        w = self.window
+        ts = np.asarray(ts_ms, dtype=np.int64)
+        latest = np.unique(ts - ts % w.advance_ms)
+        offs = np.arange(w.windows_per_record, dtype=np.int64) * w.advance_ms
+        starts = np.unique((latest[:, None] - offs[None, :]).ravel())
+        if self.watermark_abs >= 0:
+            starts = starts[starts + w.size_ms + w.grace_ms
+                            > self.watermark_abs]
+        return set(starts.tolist())
+
+    def _gap_guard(self, ts_arr: np.ndarray, sub):
+        """Gap/slot-collision guard, shared by the row and columnar paths.
+
+        Window start s occupies lattice slot (s // advance) mod W, so two
+        distinct live windows whose starts are congruent mod W*advance (a
+        stream gap / restart jump) would alias the same slot.
+
+        (a) Exact aliasing among (open windows ∪ this batch's windows):
+            split the batch in time order at the first aliasing start and
+            force-close only the open windows whose slot the suffix
+            actually needs — such windows are provably past end+grace,
+            since aliasing requires a gap of W*advance > size+grace.
+        (b) A stream-time jump past the slot horizon (even without
+            aliasing) defers closure of windows this call's records
+            aggregated into until the next call: records within a batch
+            are concurrent, so a far-future record must not retroactively
+            finalize windows its batch-mates just updated. In-horizon
+            progress still closes windows at end of batch as usual.
+
+        `sub(idx)` recursively processes the records at positions `idx`
+        (an int ndarray). Returns (emitted_rows, None) when the guard
+        split the batch (case a), or (None, new_starts) when the caller
+        should proceed — possibly after case (b) recorded deferred closes;
+        new_starts is this batch's window-start set for _track_windows."""
+        w = self.window
+        period = w.advance_ms * self.spec.n_slots
+        back = w.size_ms - w.advance_ms
+        aligned_min = _align_down(int(ts_arr.min()), w.advance_ms) - back
+        anchor = min(list(self._open) + [aligned_min])
+        horizon = anchor + (self.spec.n_slots - 1) * w.advance_ms
+        new_starts = self._new_window_starts(ts_arr)
+        by_res: dict[int, list[int]] = {}
+        for s in set(self._open) | new_starts:
+            by_res.setdefault(s % period, []).append(s)
+        colliding = [sorted(g) for g in by_res.values() if len(g) > 1]
+        if colliding:
+            cut = min(g[1] for g in colliding)  # first aliasing start
+            pre = np.nonzero(ts_arr < cut)[0]
+            suf = np.nonzero(ts_arr >= cut)[0]
+            out = []
+            if len(pre):
+                out.extend(sub(pre))
+            self._no_close |= set(self._open) & self._touched_this_call
+            suf_ts = ts_arr[suf]
+            suf_starts = self._new_window_starts(suf_ts)
+            suf_res = {s % period for s in suf_starts}
+            collide = [s for s in self._open
+                       if s % period in suf_res and s not in suf_starts]
+            if collide:
+                # real closes, not early ones — see proof above; the
+                # watermark advances to their close boundary so they
+                # cannot reopen into a now-occupied slot
+                boundary = max(s + w.size_ms + w.grace_ms for s in collide)
+                if boundary > int(suf_ts.max()):
+                    raise AssertionError(
+                        "aliasing window not due — slot layout invariant "
+                        "broken")
+                self.watermark_abs = max(self.watermark_abs, boundary)
+                for s in sorted(collide):
+                    out.extend(self._close_window(s))
+            out.extend(sub(suf))
+            return out, None
+        if int(ts_arr.max()) > horizon:
+            self._no_close |= (set(self._open) & self._touched_this_call
+                               ) | new_starts
+        return None, new_starts
+
+    def _process_batch(self, rows: list, ts_ms: list) -> list[dict[str, Any]]:
         if len(rows) > self.batch_capacity:
             out = []
             for i in range(0, len(rows), self.batch_capacity):
-                out.extend(self.process(rows[i:i + self.batch_capacity],
-                                        ts_ms[i:i + self.batch_capacity]))
+                out.extend(self._process_batch(
+                    rows[i:i + self.batch_capacity],
+                    ts_ms[i:i + self.batch_capacity]))
             return out
 
-        # Slot-collision guard: a window W*advance newer than the oldest
-        # still-open window would land in the same lattice slot. If this
-        # batch spans that far (a stream gap / restart), split it in time
-        # order and force-close due windows in between; the watermark then
-        # advances at sub-batch granularity.
+        batch_starts = None
         if self.window is not None:
-            w = self.window
-            back = w.size_ms - w.advance_ms
-            aligned_min = _align_down(min(ts_ms), w.advance_ms) - back
-            anchor = min([ow for ow in self._open] + [aligned_min])
-            threshold = anchor + (self.spec.n_slots - 1) * w.advance_ms
-            if max(ts_ms) > threshold:
-                order = sorted(range(len(rows)), key=lambda i: ts_ms[i])
-                pre = [i for i in order if ts_ms[i] <= threshold]
-                suf = [i for i in order if ts_ms[i] > threshold]
-                out = []
-                if pre:
-                    out.extend(self.process([rows[i] for i in pre],
-                                            [ts_ms[i] for i in pre]))
-                # Close the windows the suffix's watermark will make due,
-                # advancing the watermark only to their close boundaries —
-                # suffix records within grace of still-open windows keep
-                # the semantics the non-split path gives them.
-                prospective = max(ts_ms[i] for i in suf)
-                due = [s for s in self._open
-                       if s + w.size_ms + w.grace_ms <= prospective]
-                if due:
-                    boundary = max(s + w.size_ms + w.grace_ms for s in due)
-                    self.watermark_abs = max(self.watermark_abs, boundary)
-                    out.extend(self.close_due_windows())
-                out.extend(self.process([rows[i] for i in suf],
-                                        [ts_ms[i] for i in suf]))
-                return out
+            def sub(idx):
+                return self._process_batch([rows[i] for i in idx],
+                                           [ts_ms[i] for i in idx])
+
+            guarded, batch_starts = self._gap_guard(
+                np.asarray(ts_ms, dtype=np.int64), sub)
+            if guarded is not None:
+                return guarded
 
         self._ensure_epoch(min(ts_ms))
         self._maybe_rebase(max(ts_ms))
@@ -300,7 +372,6 @@ class QueryExecutor:
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
 
-        cols = {name: batch.cols[name] for name in self._needed_cols}
         # SQL NULL handling: a NULL operand makes the WHERE predicate
         # not-true (row excluded) and excludes the row from that aggregate.
         valid = batch.valid
@@ -309,18 +380,22 @@ class QueryExecutor:
             for c in columns_of(self._filter_expr):
                 fm |= batch.nulls[c]
             valid = valid & ~fm
-        for null_key, refs in self._agg_null_cols.items():
+        null_masks = []
+        for refs in self._null_refs:
             nm = np.zeros(cap, dtype=np.bool_)
             for c in refs:
                 nm |= batch.nulls[c]
-            cols[null_key] = nm
-        self.state = self._step(self.state, wm_rel, key_ids, ts_rel,
-                                valid, cols)
+            null_masks.append(nm)
+        packed = lattice.pack_batch_host(
+            cap, n, key_ids, ts_rel, valid, batch.cols, null_masks,
+            self._layout)
+        self.state = self._step(self.state, wm_rel, packed)
 
         # host window bookkeeping
         out: list[dict[str, Any]] = []
         if self.window is not None:
-            self._track_windows(np.asarray(ts_ms, dtype=np.int64))
+            self._track_windows(np.asarray(ts_ms, dtype=np.int64),
+                                batch_starts)
         new_wm = max(ts_ms)
         if new_wm > self.watermark_abs:
             self.watermark_abs = new_wm
@@ -331,22 +406,115 @@ class QueryExecutor:
         out.extend(out_closed)
         return out
 
-    def _track_windows(self, ts_abs: np.ndarray) -> None:
-        w = self.window
-        advance = w.advance_ms
-        latest = ts_abs - (ts_abs % advance)
-        starts: set[int] = set()
-        for j in range(w.windows_per_record):
-            starts.update((latest - j * advance).tolist())
-        wm = self.watermark_abs
+    def _track_windows(self, ts_abs: np.ndarray,
+                       starts: set[int] | None = None) -> None:
+        advance = self.window.advance_ms
+        if starts is None:
+            starts = self._new_window_starts(ts_abs)
         for s in starts:
             if s < self.epoch:
                 continue
-            if wm >= 0 and s + w.size_ms + w.grace_ms <= wm:
-                continue  # late, dropped on device too
+            self._touched_this_call.add(s)
             if s not in self._open:
                 slot = (((s - self.epoch) // advance) % self.spec.n_slots)
                 self._open[s] = _OpenWindow(start_abs=s, slot=slot)
+
+    def process_columnar(self, key_ids: np.ndarray, ts_ms: np.ndarray,
+                         cols: Mapping[str, np.ndarray],
+                         nulls: Mapping[str, np.ndarray] | None = None,
+                         ) -> list[dict[str, Any]]:
+        """Columnar ingest fast path: pre-encoded dense key ids + int64
+        absolute-ms timestamps + device columns, skipping per-row Python
+        decode (the production ingest path stages columnar batches from
+        the native layer). Key-dictionary state must have been populated
+        by the caller via key_id_for(); string columns must be pre-encoded
+        dictionary ids. Gap jumps that would alias lattice slots go
+        through the same _gap_guard split as the row path — rare; the
+        steady-state path is pure numpy + one jitted step."""
+        if len(key_ids) == 0:
+            return []
+        try:
+            return self._process_columnar(np.asarray(key_ids),
+                                          np.asarray(ts_ms, dtype=np.int64),
+                                          cols, nulls)
+        finally:
+            self._no_close.clear()
+            self._touched_this_call.clear()
+
+    def _process_columnar(self, key_ids, ts_ms, cols, nulls
+                          ) -> list[dict[str, Any]]:
+        n = len(key_ids)
+        cap = round_up_pow2(n, lo=min(self.batch_capacity, 256))
+        if n > self.batch_capacity:
+            out = []
+            for i in range(0, n, self.batch_capacity):
+                sl = slice(i, i + self.batch_capacity)
+                out.extend(self._process_columnar(
+                    key_ids[sl], ts_ms[sl],
+                    {k: v[sl] for k, v in cols.items()},
+                    None if nulls is None else
+                    {k: v[sl] for k, v in nulls.items()}))
+            return out
+
+        ts_list = np.asarray(ts_ms, dtype=np.int64)
+        min_ts, max_ts = int(ts_list.min()), int(ts_list.max())
+        batch_starts = None
+        if self.window is not None:
+            def sub(idx):
+                return self._process_columnar(
+                    key_ids[idx], ts_list[idx],
+                    {k: v[idx] for k, v in cols.items()},
+                    None if nulls is None else
+                    {k: v[idx] for k, v in nulls.items()})
+
+            guarded, batch_starts = self._gap_guard(ts_list, sub)
+            if guarded is not None:
+                return guarded
+
+        self._ensure_epoch(min_ts)
+        self._maybe_rebase(max_ts)
+
+        ts_rel64 = ts_list - self.epoch
+        if int(ts_rel64.max()) >= (1 << 31):
+            raise OverflowError(
+                "stream time span exceeds int32 relative range")
+        null_masks: list[np.ndarray | None] = []
+        for refs in self._null_refs:
+            if nulls is None:
+                null_masks.append(None)
+                continue
+            nm = np.zeros(n, dtype=np.bool_)
+            for c in refs:
+                if c in nulls:
+                    nm |= nulls[c][:n]
+            null_masks.append(nm)
+        packed = lattice.pack_batch_host(
+            cap, n, key_ids, ts_rel64.astype(np.int32), None, cols,
+            null_masks, self._layout)
+        wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
+                          if self.watermark_abs >= 0 else -1)
+        self.state = self._step(self.state, wm_rel, packed)
+
+        out: list[dict[str, Any]] = []
+        if self.window is not None:
+            self._track_windows(ts_list, batch_starts)
+        if max_ts > self.watermark_abs:
+            self.watermark_abs = max_ts
+        if self.emit_changes:
+            out.extend(self._drain_changes())
+        out.extend(self.close_due_windows())
+        return out
+
+    def key_id_for(self, key: tuple) -> int:
+        """Dense id for a group-key tuple (columnar-path key dictionary)."""
+        kid = self._key_ids.get(key)
+        if kid is None:
+            kid = len(self._key_rev)
+            if kid >= self.spec.n_keys:
+                self._grow_keys()
+            self._key_ids[key] = kid
+            self._key_rev.append(key)
+        return kid
 
     # ---- emission ----------------------------------------------------------
 
@@ -384,14 +552,9 @@ class QueryExecutor:
         return self._postprocess(row)
 
     def _drain_changes(self) -> list[dict[str, Any]]:
-        self.state, n, kidx, win_start_rel, outs = \
-            self._extract_touched(self.state)
-        n = int(n)
-        if n == 0:
-            return []
-        kidx = np.asarray(kidx[:n])
-        win_start_rel = np.asarray(win_start_rel[:n])
-        outs_np = {k: np.asarray(v[:n]) for k, v in outs.items()}
+        self.state, packed = self._extract_touched(self.state)
+        n, kidx, win_start_rel, outs_np = lattice.unpack_touched_rows(
+            self.spec, np.asarray(packed))
         rows = []
         for i in range(n):
             ws = (int(win_start_rel[i]) + self.epoch
@@ -401,28 +564,34 @@ class QueryExecutor:
                 rows.append(row)
         return rows
 
+    def _close_window(self, start: int) -> list[dict[str, Any]]:
+        """Pop + extract (unless changelog mode) + reset one open window."""
+        ow = self._open.pop(start)
+        rows = [] if self.emit_changes else self._extract_window_rows(ow)
+        self.state = self._reset_slot(self.state, np.int32(ow.slot))
+        self._no_close.discard(start)
+        return rows
+
     def close_due_windows(self) -> list[dict[str, Any]]:
         """Extract + reset every open window past end+grace. Host-driven."""
         if self.window is None or self.watermark_abs < 0:
             return []
         w = self.window
         due = [s for s in self._open
-               if s + w.size_ms + w.grace_ms <= self.watermark_abs]
+               if s + w.size_ms + w.grace_ms <= self.watermark_abs
+               and s not in self._no_close]
         rows: list[dict[str, Any]] = []
         for s in sorted(due):
-            ow = self._open.pop(s)
-            if not self.emit_changes:
-                rows.extend(self._extract_window_rows(ow))
-            self.state = self._reset_slot(self.state, np.int32(ow.slot))
+            rows.extend(self._close_window(s))
         return rows
 
     def _extract_window_rows(self, ow: _OpenWindow) -> list[dict[str, Any]]:
-        mask, _start_rel, outs = self._extract_slot(
-            self.state, np.int32(ow.slot))
-        mask = np.asarray(mask)
-        outs_np = {k: np.asarray(v) for k, v in outs.items()}
+        packed = np.asarray(self._extract_slot(self.state,
+                                               np.int32(ow.slot)))
+        count, _start_rel, outs_np = lattice.unpack_extract_rows(
+            self.spec, packed)
         rows = []
-        for kid in np.nonzero(mask)[0]:
+        for kid in np.nonzero(count > 0)[0]:
             row = self._agg_row(int(kid), outs_np, int(kid), ow.start_abs)
             if row is not None:
                 rows.append(row)
@@ -436,10 +605,10 @@ class QueryExecutor:
         the view store that owns this executor."""
         rows: list[dict[str, Any]] = []
         if self.window is None:
-            mask, _s, outs = self._extract_slot(self.state, np.int32(0))
-            mask = np.asarray(mask)
-            outs_np = {k: np.asarray(v) for k, v in outs.items()}
-            for kid in np.nonzero(mask)[0]:
+            packed = np.asarray(self._extract_slot(self.state, np.int32(0)))
+            count, _s, outs_np = lattice.unpack_extract_rows(self.spec,
+                                                             packed)
+            for kid in np.nonzero(count > 0)[0]:
                 row = self._agg_row(int(kid), outs_np, int(kid), None)
                 if row is not None:
                     rows.append(row)

@@ -113,10 +113,10 @@ ValueFn = Callable[[Mapping[str, jnp.ndarray]], jnp.ndarray]
 AggInput = tuple[ValueFn | None, str | None]
 
 
-def build_step(spec: LatticeSpec,
-               agg_inputs: list[AggInput],
-               filter_fn: ValueFn | None = None):
-    """Compile the micro-batch step.
+def build_step_fn(spec: LatticeSpec,
+                  agg_inputs: list[AggInput],
+                  filter_fn: ValueFn | None = None):
+    """The micro-batch step, untraced (jit/shard_map applied by callers).
 
     step(state, watermark, key_ids i32[B], ts i32[B], valid bool[B],
          cols {name: [B]}) -> state'
@@ -127,12 +127,16 @@ def build_step(spec: LatticeSpec,
     NULL and non-finite inputs do not contribute to COUNT(col) / SUM /
     AVG / MIN / MAX / sketches, matching SQL aggregate semantics.
     `filter_fn` is the WHERE mask. All are traced into the same jit.
+
+    Out-of-range key ids (negative or >= n_keys) are dropped whenever
+    `valid` is False for that record — the sharded wrapper
+    (hstream_tpu.parallel) relies on this to mask out keys owned by other
+    shards.
     """
     K, W = spec.n_keys, spec.n_slots
     n_per = spec.windows_per_record
     win = spec.window
 
-    @jax.jit
     def step(state, watermark, key_ids, ts, valid, cols):
         if filter_fn is not None:
             valid = valid & filter_fn(cols)
@@ -213,6 +217,90 @@ def build_step(spec: LatticeSpec,
     return step
 
 
+# ---- packed batch transport ------------------------------------------------
+#
+# Host->device latency, not bandwidth, dominates ingest on real deployments
+# (each transfer pays a fixed dispatch/tunnel cost), so the executor ships
+# each micro-batch as ONE int32 buffer [3 + n_cols, B]:
+#   row 0: key ids        row 1: ts (relative ms)
+#   row 2: flag bits — bit 0 valid, bit 1+j = null mask of the j-th
+#          null-tracked aggregate
+#   row 3+i: the i-th needed column (f32 bitcast / i32 / bool as 0-1)
+# Layout is a hashable tuple of (col_name, "f32"|"i32"|"bool").
+
+ColLayout = tuple[tuple[str, str], ...]
+
+
+def layout_tag(ctype) -> str:
+    from hstream_tpu.engine.types import ColumnType
+
+    return {ColumnType.FLOAT: "f32", ColumnType.INT: "i32",
+            ColumnType.BOOL: "bool", ColumnType.STRING: "i32"}[ctype]
+
+
+def pack_batch_host(capacity: int, n: int, key_ids, ts_rel, valid,
+                    cols: Mapping[str, np.ndarray],
+                    null_masks: list[np.ndarray | None],
+                    layout: ColLayout) -> np.ndarray:
+    """Assemble the packed int32 batch buffer on host (vectorized copies).
+    `valid` may be None (all n records valid)."""
+    buf = np.zeros((3 + len(layout), capacity), dtype=np.int32)
+    buf[0, :n] = key_ids[:n]
+    buf[1, :n] = ts_rel[:n]
+    if valid is None:
+        flags = np.ones(n, dtype=np.int32)  # bit0: valid
+    else:
+        flags = valid[:n].astype(np.int32)
+    for j, nm in enumerate(null_masks):
+        if nm is not None:
+            flags |= nm[:n].astype(np.int32) << (1 + j)
+    buf[2, :n] = flags
+    for i, (name, tag) in enumerate(layout):
+        src = cols[name]
+        if tag == "f32":
+            buf[3 + i, :n] = src[:n].astype(np.float32, copy=False).view(
+                np.int32)
+        elif tag == "bool":
+            buf[3 + i, :n] = src[:n].astype(np.int32)
+        else:
+            buf[3 + i, :n] = src[:n]
+    return buf
+
+
+def unpack_batch_device(packed, layout: ColLayout, null_keys):
+    """(key_ids, ts, valid, cols) from the packed buffer, traced."""
+    key_ids = packed[0]
+    ts = packed[1]
+    flags = packed[2]
+    valid = (flags & 1) != 0
+    cols = {}
+    for i, (name, tag) in enumerate(layout):
+        row = packed[3 + i]
+        if tag == "f32":
+            cols[name] = jax.lax.bitcast_convert_type(row, jnp.float32)
+        elif tag == "bool":
+            cols[name] = row != 0
+        else:
+            cols[name] = row
+    for j, nk in enumerate(nk for nk in null_keys if nk is not None):
+        cols[nk] = ((flags >> (1 + j)) & 1) != 0
+    return key_ids, ts, valid, cols
+
+
+def build_step_packed(spec: LatticeSpec, agg_inputs: list[AggInput],
+                      filter_fn: ValueFn | None, layout: ColLayout,
+                      null_keys) -> Callable:
+    """step(state, watermark, packed i32[3+n_cols, B]) -> state'."""
+    base = build_step_fn(spec, agg_inputs, filter_fn)
+
+    def step(state, watermark, packed):
+        key_ids, ts, valid, cols = unpack_batch_device(packed, layout,
+                                                       null_keys)
+        return base(state, watermark, key_ids, ts, valid, cols)
+
+    return step
+
+
 def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
     """Finalize one slot column {plane: [K, ...]} -> {out_name: [K] f32}."""
     outs = {}
@@ -237,19 +325,41 @@ def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
     return outs
 
 
-def build_extract_slot(spec: LatticeSpec):
-    """extract(state, slot) -> (mask [K], win_start scalar, {name: [K]}).
+def pack_extract_rows(spec: LatticeSpec, count, win_start, outs):
+    """Stack (count, win_start, finalized agg outputs) into ONE int32
+    buffer [2 + n_aggs, K] (float outputs bitcast) so the host pays a
+    single device->host fetch per drain instead of one per plane — host
+    sync count, not bytes, dominates drain cost."""
+    k = count.shape[0]
+    rows = [count.astype(jnp.int32),
+            jnp.broadcast_to(jnp.asarray(win_start, jnp.int32), (k,))]
+    for agg in spec.aggs:
+        rows.append(jax.lax.bitcast_convert_type(
+            outs[agg.out_name].astype(jnp.float32), jnp.int32))
+    return jnp.stack(rows)
 
-    Finalized values for one slot column; called by the host when the
-    watermark closes a window. Off the hot path."""
+
+def unpack_extract_rows(spec: LatticeSpec, packed: np.ndarray):
+    """(count [K], win_start [K], {name: [K] f32}) from pack_extract_rows."""
+    count = packed[0]
+    win_start = packed[1]
+    outs = {agg.out_name: packed[2 + i].view(np.float32)
+            for i, agg in enumerate(spec.aggs)}
+    return count, win_start, outs
+
+
+def build_extract_slot(spec: LatticeSpec):
+    """extract(state, slot) -> packed int32 [2+n_aggs, K] (see
+    pack_extract_rows): finalized values for one slot column, fetched by
+    the host in a single transfer when the watermark closes a window."""
 
     @jax.jit
     def extract(state, slot):
         col = {k: v[:, slot] for k, v in state.items()
                if k not in ("slot_start", "touched")}
         outs = finalize_column(spec, col)
-        mask = col["count"] > 0
-        return mask, state["slot_start"][slot], outs
+        return pack_extract_rows(spec, col["count"],
+                                 state["slot_start"][slot], outs)
 
     return extract
 
@@ -279,12 +389,33 @@ def init_value(agg: AggSpec):
     return 0
 
 
+def pack_touched_rows(spec: LatticeSpec, n, kidx, win_start, outs,
+                      max_out: int):
+    """ONE int32 buffer [3 + n_aggs, max_out]: row0 col0 = n, row1 = key
+    ids, row2 = win starts, rows 3+ = bitcast float agg outputs."""
+    rows = [jnp.zeros((max_out,), jnp.int32).at[0].set(n),
+            kidx.astype(jnp.int32), win_start.astype(jnp.int32)]
+    for agg in spec.aggs:
+        rows.append(jax.lax.bitcast_convert_type(
+            outs[agg.out_name].astype(jnp.float32), jnp.int32))
+    return jnp.stack(rows)
+
+
+def unpack_touched_rows(spec: LatticeSpec, packed: np.ndarray):
+    """(n, kidx [n], win_start [n], {name: [n] f32})."""
+    n = int(packed[0, 0])
+    outs = {agg.out_name: packed[3 + i, :n].view(np.float32)
+            for i, agg in enumerate(spec.aggs)}
+    return n, packed[1, :n], packed[2, :n], outs
+
+
 def build_extract_touched(spec: LatticeSpec, max_out: int):
     """Changelog extraction for EMIT CHANGES: all (key, window) pairs
     touched since the last call, with finalized current values.
 
     extract(state) -> (state with touched cleared,
-                       n scalar, key_idx [E], win_start [E], {name: [E]})
+                       packed int32 [3+n_aggs, max_out] — see
+                       pack_touched_rows)
 
     Deviation from the reference (documented): the reference emits one
     change per input record (TimeWindowedStream.hs:101); a batched engine
@@ -299,29 +430,39 @@ def build_extract_touched(spec: LatticeSpec, max_out: int):
         col = {k: v[kidx, sidx] for k, v in state.items()
                if k not in ("slot_start", "touched")}
         outs = finalize_column(spec, col)
-        win_start = state["slot_start"][sidx]
+        win_start = jnp.where(valid, state["slot_start"][sidx], 0)
         out_state = dict(state)
         out_state["touched"] = jnp.zeros_like(mask)
-        return out_state, n, kidx, jnp.where(valid, win_start, 0), outs
+        return out_state, pack_touched_rows(spec, n, kidx, win_start,
+                                            outs, max_out)
 
     return extract
 
 
-class CompiledLattice(NamedTuple):
-    step: Callable
-    extract_slot: Callable
-    reset_slot: Callable
-    extract_touched: Callable
-    null_keys: tuple[str | None, ...]  # per agg: the __null_a{i} cols key
+def plane_merge_kinds(spec: LatticeSpec) -> dict[str, str]:
+    """Monoid merge op per state plane ("sum" | "min" | "max").
+
+    Every accumulator is a commutative monoid, so partial lattices from
+    different chips (or a restored checkpoint plus fresh state) combine
+    exactly with these elementwise ops. `touched` merges with max (logical
+    or); `slot_start` with max (EMPTY_START is the identity)."""
+    kinds = {"count": "sum", "touched": "max", "slot_start": "max"}
+    for i, agg in enumerate(spec.aggs):
+        name = _plane_name(i, agg)
+        if agg.kind == AggKind.MIN:
+            kinds[name] = "min"
+        elif agg.kind in (AggKind.MAX, AggKind.APPROX_COUNT_DISTINCT):
+            kinds[name] = "max"
+        else:
+            kinds[name] = "sum"
+            if agg.kind == AggKind.AVG:
+                kinds[name + "_n"] = "sum"
+    return kinds
 
 
-@functools.lru_cache(maxsize=512)
-def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int
-             ) -> CompiledLattice:
-    """Shared, cached compilation of all lattice functions for a given
-    (spec, schema, filter) — executors with identical shapes reuse the same
-    jitted callables (and therefore the same XLA executables). Requires
-    expressions with string literals pre-encoded (expr.encode_strings)."""
+def compile_agg_inputs(spec: LatticeSpec, schema) -> tuple[
+        list[AggInput], tuple[str | None, ...]]:
+    """Device value-fns + null-mask column keys for each aggregate."""
     from hstream_tpu.engine.expr import compile_device
 
     agg_inputs: list[AggInput] = []
@@ -334,14 +475,37 @@ def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int
             key = f"__null_a{i}"
             agg_inputs.append((compile_device(agg.input, schema), key))
             null_keys.append(key)
+    return agg_inputs, tuple(null_keys)
+
+
+class CompiledLattice(NamedTuple):
+    step: Callable
+    extract_slot: Callable
+    reset_slot: Callable
+    extract_touched: Callable
+    null_keys: tuple[str | None, ...]  # per agg: the __null_a{i} cols key
+
+
+@functools.lru_cache(maxsize=512)
+def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int,
+             layout: ColLayout) -> CompiledLattice:
+    """Shared, cached compilation of all lattice functions for a given
+    (spec, schema, filter, layout) — executors with identical shapes reuse
+    the same jitted callables (and therefore the same XLA executables).
+    Requires expressions with string literals pre-encoded
+    (expr.encode_strings)."""
+    from hstream_tpu.engine.expr import compile_device
+
+    agg_inputs, null_keys = compile_agg_inputs(spec, schema)
     filter_fn = compile_device(filter_expr, schema) if filter_expr is not None \
         else None
     return CompiledLattice(
-        step=build_step(spec, agg_inputs, filter_fn),
+        step=jax.jit(build_step_packed(spec, agg_inputs, filter_fn,
+                                       layout, null_keys)),
         extract_slot=build_extract_slot(spec),
         reset_slot=build_reset_slot(spec),
         extract_touched=build_extract_touched(spec, max_out),
-        null_keys=tuple(null_keys),
+        null_keys=null_keys,
     )
 
 

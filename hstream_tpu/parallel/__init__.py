@@ -1,0 +1,27 @@
+"""Multi-chip scaling: mesh-sharded lattices and executors.
+
+See hstream_tpu.parallel.lattice for the sharding design (data-parallel
+partial lattices + key-sharded planes over a 2-D mesh, monoid merges at
+drain points riding ICI).
+"""
+
+from hstream_tpu.parallel.lattice import ShardedLattice
+from hstream_tpu.parallel.executor import ShardedQueryExecutor
+
+
+def make_mesh(n_data: int | None = None, n_key: int = 1,
+              devices=None):
+    """A (data, key) mesh over the available devices (row-major)."""
+    import jax
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_key
+    arr = np.asarray(devices[:n_data * n_key]).reshape(n_data, n_key)
+    from jax.sharding import Mesh
+
+    return Mesh(arr, ("data", "key"))
+
+
+__all__ = ["ShardedLattice", "ShardedQueryExecutor", "make_mesh"]

@@ -1,0 +1,114 @@
+"""Host executor for mesh-sharded queries.
+
+Same host-side semantics as engine.QueryExecutor (watermark, window
+bookkeeping, key dictionary, emission); only the device callables differ —
+they come from a ShardedLattice, so every process() scatters a sharded
+batch into per-chip partial lattices and drains merge over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from hstream_tpu.engine import lattice as se_lattice
+from hstream_tpu.engine.executor import QueryExecutor
+from hstream_tpu.engine.plan import AggregateNode
+from hstream_tpu.engine.types import Schema
+from hstream_tpu.parallel.lattice import ShardedLattice
+
+
+class ShardedQueryExecutor(QueryExecutor):
+    """QueryExecutor whose lattice lives sharded over a device mesh.
+
+    ``initial_keys`` is the fixed GLOBAL key capacity (must divide by the
+    key-axis size). Key growth re-shards through the host — rare and
+    logged; size capacity generously for production queries.
+    """
+
+    def __init__(self, node: AggregateNode, schema: Schema, *, mesh,
+                 data_axis: str = "data", key_axis: str = "key",
+                 emit_changes: bool = True, initial_keys: int = 1024,
+                 batch_capacity: int = 4096):
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._key_axis = key_axis
+        super().__init__(node, schema, emit_changes=emit_changes,
+                         initial_keys=initial_keys,
+                         batch_capacity=batch_capacity)
+
+    def _compile(self) -> None:
+        from hstream_tpu.engine.expr import columns_of
+
+        self._layout = tuple(
+            (name, se_lattice.layout_tag(self.schema.type_of(name)))
+            for name in self._needed_cols)
+        sharded = ShardedLattice(
+            self.spec, self.schema, self._filter_expr,
+            self.batch_capacity * self.spec.windows_per_record,
+            self._mesh, self._layout, data_axis=self._data_axis,
+            key_axis=self._key_axis)
+        self._sharded = sharded
+        self._step = sharded.step
+        self._extract_slot = sharded.extract_slot
+        self._reset_slot = sharded.reset_slot
+        self._extract_touched = sharded.extract_touched
+        self._null_refs = [
+            sorted(columns_of(agg.input))
+            for key, agg in zip(sharded.null_keys, self.spec.aggs)
+            if key is not None
+        ]
+        # Replace single-chip state from the base __init__ with sharded
+        # state (keyed planes gain a leading data-shard axis); the grow
+        # path installs its own padded arrays instead.
+        if not getattr(self, "_defer_state_init", False):
+            cur = getattr(self, "state", None)
+            if cur is None or cur["count"].ndim == 2:
+                self.state = sharded.init_state()
+
+    def _grow_keys(self) -> None:
+        # gather → pad global key axis (axis 1 of keyed planes) → re-shard
+        import jax
+
+        new_k = self.spec.n_keys * 2
+        kinds = se_lattice.plane_merge_kinds(self.spec)
+        extra = new_k - self.spec.n_keys
+        host = {k: np.asarray(v) for k, v in self.state.items()}
+        grown = {}
+        for k, v in host.items():
+            if k == "slot_start":
+                grown[k] = v
+                continue
+            pad = [(0, 0), (0, extra)] + [(0, 0)] * (v.ndim - 2)
+            fill = (np.inf if kinds.get(k) == "min"
+                    else -np.inf if kinds.get(k) == "max" and
+                    v.dtype == np.float32 else 0)
+            grown[k] = np.pad(v, pad, constant_values=fill)
+        self.spec = se_lattice.LatticeSpec(
+            n_keys=new_k, window=self.spec.window, aggs=self.spec.aggs,
+            hll=self.spec.hll, qcfg=self.spec.qcfg)
+        self._defer_state_init = True
+        try:
+            self._compile()
+        finally:
+            self._defer_state_init = False
+        self.state = {
+            k: jax.device_put(v, self._sharded.state_sharding(k))
+            for k, v in grown.items()
+        }
+
+    def _drain_changes(self) -> list[dict[str, Any]]:
+        self.state, touched = self._sharded.drain_touched(self.state)
+        rows = []
+        for kid, ws_rel, outs in touched:
+            ws = ws_rel + self.epoch if self.window is not None else None
+            row = self._agg_row_from_scalars(kid, outs, ws)
+            if row is not None:
+                rows.append(row)
+        return rows
+
+    def _agg_row_from_scalars(self, kid: int, outs: dict[str, float],
+                              win_start_abs: int | None):
+        arr = {k: np.asarray([v]) for k, v in outs.items()}
+        return self._agg_row(kid, arr, 0, win_start_abs)

@@ -1,0 +1,247 @@
+"""Multi-chip sharding of the window-state lattice.
+
+The reference is single-process for compute — its only cross-host axes are
+storage replication and round-robin consumer dispatch (SURVEY §2.3;
+hstream/src/HStream/Server/Handler.hs:896-922). The TPU-native design
+scales the aggregation hot path itself over a 2-D device mesh:
+
+  * ``data`` axis — records of each micro-batch are sharded across chips;
+    every chip scatters its shard into a **partial lattice**. Because all
+    accumulator planes are commutative monoids (lattice.plane_merge_kinds),
+    partials merge exactly at drain points.
+  * ``key`` axis — the key dimension of every plane is sharded, bounding
+    per-chip HBM. Records are broadcast along ``key`` (the batch in_spec
+    only names the data axis) and each chip masks the scatter to the key
+    range it owns — no all-to-all in the hot path; the scatter itself does
+    the routing.
+
+State arrays carry a leading device axis of length ``D`` (the data-axis
+size): a keyed plane is ``[D, K, W, ...]`` sharded
+``P(data, key)``. The hot step runs under ``jax.shard_map`` with **zero
+collectives**; merges (psum / pmin / pmax over ``data``, all riding ICI)
+happen only when the host drains state — window close, changelog pull,
+view peek — amortized over the window length.
+
+This mirrors the scaling-book recipe: pick a mesh, annotate shardings, let
+the compiled collectives ride ICI. DCN never sees lattice traffic; it is
+reserved for the log-store replication plane (hstream_tpu.store).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Mapping, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hstream_tpu.engine import lattice
+from hstream_tpu.engine.lattice import (
+    EMPTY_START,
+    LatticeSpec,
+    build_step_fn,
+    compile_agg_inputs,
+    finalize_column,
+    init_value,
+    plane_merge_kinds,
+)
+
+_MERGE = {
+    "sum": jax.lax.psum,
+    "min": jax.lax.pmin,
+    "max": jax.lax.pmax,
+}
+
+
+def _keyed(name: str) -> bool:
+    return name != "slot_start"
+
+
+class ShardedLattice:
+    """The lattice of one query, sharded over a (data, key) mesh.
+
+    Drop-in provider of the CompiledLattice callables with identical
+    signatures (state first, host scalars as np types), so the host
+    executor drives single-chip and multi-chip lattices the same way.
+    ``n_keys`` of ``spec`` is the GLOBAL key capacity; it must divide by
+    the key-axis size.
+    """
+
+    def __init__(self, spec: LatticeSpec, schema, filter_expr,
+                 max_out: int, mesh: Mesh, layout,
+                 data_axis: str = "data", key_axis: str = "key"):
+        from hstream_tpu.engine.expr import compile_device
+
+        self.layout = layout
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.key_axis = key_axis if key_axis in mesh.axis_names else None
+        self.n_data = mesh.shape[data_axis]
+        self.n_key = mesh.shape[self.key_axis] if self.key_axis else 1
+        if spec.n_keys % self.n_key != 0:
+            raise ValueError(
+                f"global key capacity {spec.n_keys} not divisible by "
+                f"key-axis size {self.n_key}")
+        self.spec = spec
+        self.local_spec = LatticeSpec(
+            n_keys=spec.n_keys // self.n_key, window=spec.window,
+            aggs=spec.aggs, hll=spec.hll, qcfg=spec.qcfg)
+        self.max_out = max_out
+
+        agg_inputs, self.null_keys = compile_agg_inputs(spec, schema)
+        filter_fn = (compile_device(filter_expr, schema)
+                     if filter_expr is not None else None)
+        self._local_step = build_step_fn(self.local_spec, agg_inputs,
+                                         filter_fn)
+        self._merge_kinds = plane_merge_kinds(spec)
+        self._state_specs = None  # built lazily from init_state's tree
+        self._build()
+
+    # ---- sharding specs ----------------------------------------------------
+
+    def state_spec(self, name: str) -> P:
+        if _keyed(name):
+            return P(self.data_axis, self.key_axis)
+        return P(self.data_axis)
+
+    def state_sharding(self, name: str) -> NamedSharding:
+        return NamedSharding(self.mesh, self.state_spec(name))
+
+    def init_state(self) -> dict[str, jnp.ndarray]:
+        """Global sharded state: local init replicated along ``data`` (all
+        init values are merge identities, so D partial copies are exact)."""
+        local = lattice.init_state(self.spec)  # global K, host-side
+        out = {}
+        for name, v in local.items():
+            g = jnp.broadcast_to(v[None], (self.n_data,) + v.shape)
+            out[name] = jax.device_put(g, self.state_sharding(name))
+        return out
+
+    def _specs_of(self, state_tree: Mapping[str, jnp.ndarray]):
+        return {k: self.state_spec(k) for k in state_tree}
+
+    # ---- compiled callables ------------------------------------------------
+
+    def _build(self) -> None:
+        mesh = self.mesh
+        data_axis, key_axis = self.data_axis, self.key_axis
+        Kl = self.local_spec.n_keys
+        merge = self._merge_kinds
+        spec_tree = {k: self.state_spec(k)
+                     for k in lattice.init_state(self.spec)}
+        local_spec = self.local_spec
+
+        def key_offset():
+            if key_axis is None:
+                return 0
+            return jax.lax.axis_index(key_axis) * Kl
+
+        layout, null_keys = self.layout, self.null_keys
+
+        def step_local(state, watermark, packed):
+            local = {k: v[0] for k, v in state.items()}
+            key_ids, ts, valid, cols = lattice.unpack_batch_device(
+                packed, layout, null_keys)
+            kid = key_ids - key_offset()
+            ok = valid & (kid >= 0) & (kid < Kl)
+            new = self._local_step(local, watermark, kid, ts, ok, cols)
+            return {k: v[None] for k, v in new.items()}
+
+        # packed batch [rows, B]: rows replicated, records sharded on data
+        self.step = jax.jit(jax.shard_map(
+            step_local, mesh=mesh,
+            in_specs=(spec_tree, P(), P(None, data_axis)),
+            out_specs=spec_tree, check_vma=False))
+
+        def merged_col(state, slot):
+            """One slot column, merged over the data axis -> {plane: [Kl]}"""
+            col = {}
+            for k, v in state.items():
+                if k in ("slot_start", "touched"):
+                    continue
+                col[k] = _MERGE[merge[k]](v[0, :, slot], data_axis)
+            return col
+
+        def extract_local(state, slot):
+            col = merged_col(state, slot)
+            outs = finalize_column(local_spec, col)
+            ws = jax.lax.pmax(state["slot_start"][0, slot], data_axis)
+            return lattice.pack_extract_rows(local_spec, col["count"],
+                                             ws, outs)
+
+        # packed [2+n_aggs, K] — key axis concatenated over shards
+        self.extract_slot = jax.jit(jax.shard_map(
+            extract_local, mesh=mesh,
+            in_specs=(spec_tree, P()),
+            out_specs=P(None, key_axis), check_vma=False))
+
+        def reset_local(state, slot):
+            out = dict(state)
+            for i, agg in enumerate(local_spec.aggs):
+                name = lattice._plane_name(i, agg)
+                out[name] = state[name].at[:, :, slot].set(init_value(agg))
+                if agg.kind == lattice.AggKind.AVG:
+                    out[name + "_n"] = state[name + "_n"].at[
+                        :, :, slot].set(0)
+            out["count"] = state["count"].at[:, :, slot].set(0)
+            out["touched"] = state["touched"].at[:, :, slot].set(False)
+            out["slot_start"] = state["slot_start"].at[:, slot].set(
+                EMPTY_START)
+            return out
+
+        self.reset_slot = jax.jit(jax.shard_map(
+            reset_local, mesh=mesh,
+            in_specs=(spec_tree, P()),
+            out_specs=spec_tree, check_vma=False))
+
+        max_out = self.max_out
+
+        def touched_local(state):
+            # changelog across shards: merge the full lattice over `data`
+            # (the one drain that pays a whole-lattice collective), then
+            # enumerate per key-shard
+            mask = jax.lax.pmax(state["touched"][0].astype(jnp.int32),
+                                data_axis).astype(jnp.bool_)
+            n = jnp.sum(mask.astype(jnp.int32))
+            kidx, sidx = jnp.nonzero(mask, size=max_out, fill_value=0)
+            col = {}
+            for k, v in state.items():
+                if k in ("slot_start", "touched"):
+                    continue
+                m = _MERGE[merge[k]](v[0], data_axis)
+                col[k] = m[kidx, sidx]
+            outs = finalize_column(local_spec, col)
+            ws_merged = jax.lax.pmax(state["slot_start"][0], data_axis)
+            valid = jnp.arange(max_out) < n
+            out_state = dict(state)
+            out_state["touched"] = jnp.zeros_like(state["touched"])
+            kid_global = kidx + key_offset()
+            packed = lattice.pack_touched_rows(
+                local_spec, n, kid_global,
+                jnp.where(valid, ws_merged[sidx], 0), outs, max_out)
+            return out_state, packed[None]
+
+        # packed per-key-shard buffers stacked on a leading axis
+        self.extract_touched = jax.jit(jax.shard_map(
+            touched_local, mesh=mesh,
+            in_specs=(spec_tree,),
+            out_specs=(spec_tree, P(key_axis)), check_vma=False))
+
+    # ---- host-side helpers -------------------------------------------------
+
+    def drain_touched(self, state):
+        """Run extract_touched and flatten the per-key-shard results into
+        (state', [(kid_global, win_start_rel, {name: value})...]) — one
+        host fetch for the whole changelog."""
+        state, packed = self.extract_touched(state)
+        packed = np.asarray(packed)
+        rows = []
+        for s in range(self.n_key):
+            n, kidx, ws, outs = lattice.unpack_touched_rows(
+                self.local_spec, packed[s])
+            for i in range(n):
+                rows.append((int(kidx[i]), int(ws[i]),
+                             {k: float(v[i]) for k, v in outs.items()}))
+        return state, rows

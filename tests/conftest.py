@@ -7,9 +7,19 @@ must be set before jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment selects a TPU platform
+# (e.g. JAX_PLATFORMS=axon, whose plugin overrides the env var through
+# jax.config): unit tests use tiny shapes where CPU is faster, and the
+# virtual 8-device mesh needs the host platform. Set HSTREAM_TEST_PLATFORM
+# to override (e.g. to run the suite on real TPU).
+_platform = os.environ.get("HSTREAM_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (env vars above must precede first import)
+
+jax.config.update("jax_platforms", _platform)
