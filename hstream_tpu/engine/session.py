@@ -1,0 +1,328 @@
+"""Session-window aggregation.
+
+Reference semantics (SessionWindowedStream.hs:84-118): a record at ts
+belongs to session [ts, ts]; sessions of the same key merge when their
+gap-extended intervals overlap (ts within `gap` of the session edge);
+a session closes when the watermark passes end + gap + grace.
+
+Merge-on-overlap is inherently sequential per key, so the design follows
+SURVEY §7: per-batch segmentation is vectorized (lexsort by (key, ts) +
+gap-break detection + reduceat segment reduction), then the few resulting
+segment aggregates merge into per-key session state on the host. All
+accumulators are monoids, so segment/session merges are exact. Device
+offload of the segmentation is a later optimization — per-batch work is
+O(B log B) numpy, and segment counts are tiny compared to record counts.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.engine.executor import QueryExecutor
+from hstream_tpu.engine.expr import eval_host
+from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec
+from hstream_tpu.engine.sketches import HLLConfig, QuantileConfig
+from hstream_tpu.engine.types import Schema
+from hstream_tpu.engine.window import SessionWindow
+
+
+# ---- numpy sketch helpers (host-side finalize) -----------------------------
+
+def hll_update_np(values: np.ndarray, cfg: HLLConfig):
+    """(register idx, rank) per value — numpy mirror of
+    sketches.hll_update_indices (same hash, same estimates merge)."""
+    v = np.ascontiguousarray(values, dtype=np.float32)
+    v = np.where(v == 0.0, np.float32(0.0), v)
+    h = v.view(np.uint32).copy()
+    h ^= h >> 16
+    h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> 13
+    h = (h * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> 16
+    p = cfg.precision
+    reg = (h >> (32 - p)).astype(np.int64)
+    w = (h << p) & np.uint32(0xFFFFFFFF)
+    # count leading zeros of remaining bits
+    rank = np.zeros(len(v), dtype=np.int64)
+    x = w.copy()
+    for shift in (16, 8, 4, 2, 1):
+        empty = (x >> (32 - shift)) == 0
+        rank += np.where(empty, shift, 0)
+        x = np.where(empty, (x << shift) & np.uint32(0xFFFFFFFF), x)
+    rank = np.where(w == 0, 32, rank)
+    rank = np.minimum(rank + 1, 32 - p + 1).astype(np.int8)
+    return reg, rank
+
+
+def hll_estimate_np(registers: np.ndarray, cfg: HLLConfig) -> float:
+    m = cfg.m
+    if m == 16:
+        alpha = 0.673
+    elif m == 32:
+        alpha = 0.697
+    elif m == 64:
+        alpha = 0.709
+    else:
+        alpha = 0.7213 / (1 + 1.079 / m)
+    regs = registers.astype(np.float64)
+    raw = alpha * m * m / np.sum(np.exp2(-regs))
+    zeros = int(np.sum(registers == 0))
+    if raw <= 2.5 * m and zeros > 0:
+        return m * math.log(m / zeros)
+    return float(raw)
+
+
+def quantile_bin_np(values: np.ndarray, cfg: QuantileConfig) -> np.ndarray:
+    v = np.maximum(values.astype(np.float64), 0.0)
+    safe = np.maximum(v, cfg.min_value)
+    b = np.floor(np.log(safe / cfg.min_value) / cfg.gamma_log).astype(
+        np.int64) + 1
+    b = np.clip(b, 1, cfg.n_bins - 1)
+    return np.where(v < cfg.min_value, 0, b)
+
+
+def quantile_estimate_np(hist: np.ndarray, q: float,
+                         cfg: QuantileConfig) -> float:
+    total = hist.sum()
+    if total == 0:
+        return 0.0
+    cdf = np.cumsum(hist)
+    idx = int(np.searchsorted(cdf, q * total, side="left"))
+    idx = min(idx, cfg.n_bins - 1)
+    if idx == 0:
+        return 0.0
+    log_lo = (idx - 1.0) * cfg.gamma_log
+    return float(cfg.min_value * math.exp(log_lo + 0.5 * cfg.gamma_log))
+
+
+# ---- session state ---------------------------------------------------------
+
+@dataclass
+class _Session:
+    start: int
+    end: int                      # last record ts
+    accs: dict[str, Any] = field(default_factory=dict)
+
+
+def _acc_init(agg: AggSpec, hll: HLLConfig, qcfg: QuantileConfig):
+    if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+        return 0
+    if agg.kind in (AggKind.SUM,):
+        return 0.0
+    if agg.kind == AggKind.AVG:
+        return (0.0, 0)
+    if agg.kind == AggKind.MIN:
+        return math.inf
+    if agg.kind == AggKind.MAX:
+        return -math.inf
+    if agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+        return np.zeros(hll.m, dtype=np.int8)
+    if agg.kind == AggKind.APPROX_QUANTILE:
+        return np.zeros(qcfg.n_bins, dtype=np.int64)
+    raise SQLCodegenError(f"session agg {agg.kind} unsupported")
+
+
+def _acc_merge(agg: AggSpec, a, b):
+    if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT, AggKind.SUM):
+        return a + b
+    if agg.kind == AggKind.AVG:
+        return (a[0] + b[0], a[1] + b[1])
+    if agg.kind == AggKind.MIN:
+        return min(a, b)
+    if agg.kind == AggKind.MAX:
+        return max(a, b)
+    if agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+        return np.maximum(a, b)
+    if agg.kind == AggKind.APPROX_QUANTILE:
+        return a + b
+    raise SQLCodegenError(f"session agg {agg.kind} unsupported")
+
+
+class SessionExecutor:
+    """Windowed-by-session grouped aggregation (host merge engine).
+
+    API-compatible with QueryExecutor: process(rows, ts_ms) -> emitted
+    rows; emitted rows carry winStart/winEnd = [session start,
+    session end + gap) like the reference's session serde."""
+
+    def __init__(self, node: AggregateNode, schema: Schema, *,
+                 emit_changes: bool = False,
+                 hll: HLLConfig = HLLConfig(),
+                 qcfg: QuantileConfig = QuantileConfig()):
+        if not isinstance(node.window, SessionWindow):
+            raise SQLCodegenError("SessionExecutor needs a SessionWindow")
+        self.node = node
+        self.schema = schema
+        self.window: SessionWindow = node.window
+        self.emit_changes = emit_changes
+        self.hll = hll
+        self.qcfg = qcfg
+        self.group_cols = [g.name for g in node.group_keys]
+        self.aggs = list(node.aggs)
+        self.watermark: int = -1
+        # key tuple -> list[_Session], kept sorted by start
+        self.sessions: dict[tuple, list[_Session]] = {}
+        self._filter = QueryExecutor._extract_filter(self)  # same chain walk
+
+    # QueryExecutor._extract_filter reads self.node only.
+
+    def _agg_input(self, agg: AggSpec, row: Mapping[str, Any]):
+        if agg.input is None:
+            return 1
+        try:
+            v = eval_host(agg.input, row)
+        except (TypeError, KeyError):
+            return None
+        if v is None or (isinstance(v, float) and not math.isfinite(v)):
+            return None
+        return v
+
+    def _acc_update(self, agg: AggSpec, acc, v):
+        if agg.kind == AggKind.COUNT_ALL:
+            return acc + 1
+        if v is None:
+            return acc
+        if agg.kind == AggKind.COUNT:
+            return acc + 1
+        if agg.kind == AggKind.SUM:
+            return acc + float(v)
+        if agg.kind == AggKind.AVG:
+            return (acc[0] + float(v), acc[1] + 1)
+        if agg.kind == AggKind.MIN:
+            return min(acc, float(v))
+        if agg.kind == AggKind.MAX:
+            return max(acc, float(v))
+        if agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+            reg, rank = hll_update_np(np.asarray([float(v)]), self.hll)
+            acc = acc.copy()
+            acc[reg[0]] = max(acc[reg[0]], rank[0])
+            return acc
+        if agg.kind == AggKind.APPROX_QUANTILE:
+            b = int(quantile_bin_np(np.asarray([float(v)]), self.qcfg)[0])
+            acc = acc.copy()
+            acc[b] += 1
+            return acc
+        raise SQLCodegenError(f"session agg {agg.kind} unsupported")
+
+    def process(self, rows: Sequence[Mapping[str, Any]],
+                ts_ms: Sequence[int]) -> list[dict[str, Any]]:
+        if not rows:
+            return []
+        gap = self.window.gap_ms
+        grace = self.window.grace_ms
+        touched: set[tuple] = set()
+        order = sorted(range(len(rows)), key=lambda i: ts_ms[i])
+        for i in order:
+            row, ts = rows[i], int(ts_ms[i])
+            if self._filter is not None:
+                try:
+                    if not eval_host(self._filter, row):
+                        continue
+                except (TypeError, KeyError):
+                    continue
+            key = tuple(row.get(c) for c in self.group_cols)
+            # late: would it merge only into closed territory?
+            if self.watermark >= 0 and ts + gap + grace <= self.watermark:
+                continue
+            sess_list = self.sessions.setdefault(key, [])
+            # find sessions overlapping [ts - gap, ts + gap]
+            overl = [s for s in sess_list
+                     if s.start - gap <= ts <= s.end + gap]
+            if overl:
+                merged = overl[0]
+                for s in overl[1:]:
+                    merged.end = max(merged.end, s.end)
+                    merged.start = min(merged.start, s.start)
+                    for a in self.aggs:
+                        merged.accs[a.out_name] = _acc_merge(
+                            a, merged.accs[a.out_name], s.accs[a.out_name])
+                    sess_list.remove(s)
+                merged.start = min(merged.start, ts)
+                merged.end = max(merged.end, ts)
+                target = merged
+            else:
+                target = _Session(start=ts, end=ts, accs={
+                    a.out_name: _acc_init(a, self.hll, self.qcfg)
+                    for a in self.aggs})
+                sess_list.append(target)
+                sess_list.sort(key=lambda s: s.start)
+            for a in self.aggs:
+                target.accs[a.out_name] = self._acc_update(
+                    a, target.accs[a.out_name],
+                    self._agg_input(a, row))
+            touched.add(key)
+        new_wm = max(int(t) for t in ts_ms)
+        if new_wm > self.watermark:
+            self.watermark = new_wm
+
+        out: list[dict[str, Any]] = []
+        if self.emit_changes:
+            for key in touched:
+                for s in self.sessions.get(key, []):
+                    r = self._emit_row(key, s)
+                    if r is not None:
+                        out.append(r)
+        out.extend(self.close_due_sessions())
+        return out
+
+    def close_due_sessions(self) -> list[dict[str, Any]]:
+        gap, grace = self.window.gap_ms, self.window.grace_ms
+        rows = []
+        for key, sess_list in list(self.sessions.items()):
+            due = [s for s in sess_list
+                   if s.end + gap + grace <= self.watermark]
+            for s in due:
+                if not self.emit_changes:
+                    rows.append(self._emit_row(key, s))
+                sess_list.remove(s)
+            if not sess_list:
+                del self.sessions[key]
+        return [r for r in rows if r is not None]
+
+    def _finalize(self, agg: AggSpec, acc):
+        if agg.kind == AggKind.AVG:
+            return acc[0] / max(acc[1], 1)
+        if agg.kind == AggKind.MIN:
+            return 0.0 if acc == math.inf else acc
+        if agg.kind == AggKind.MAX:
+            return 0.0 if acc == -math.inf else acc
+        if agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+            return int(round(hll_estimate_np(acc, self.hll)))
+        if agg.kind == AggKind.APPROX_QUANTILE:
+            return quantile_estimate_np(acc, agg.quantile or 0.5, self.qcfg)
+        return acc
+
+    def _emit_row(self, key: tuple, s: _Session) -> dict[str, Any] | None:
+        row = dict(zip(self.group_cols, key))
+        for a in self.aggs:
+            row[a.out_name] = self._finalize(a, s.accs[a.out_name])
+        row["winStart"] = s.start
+        row["winEnd"] = s.end + self.window.gap_ms
+        if self.node.having is not None:
+            try:
+                if not eval_host(self.node.having, row):
+                    return None
+            except (TypeError, KeyError):
+                return None
+        if self.node.post_projections:
+            proj = {}
+            for name, expr in self.node.post_projections:
+                proj[name] = eval_host(expr, row)
+            for meta in ("winStart", "winEnd"):
+                proj[meta] = row[meta]
+            return proj
+        return row
+
+    def peek(self) -> list[dict[str, Any]]:
+        rows = []
+        for key, sess_list in self.sessions.items():
+            for s in sess_list:
+                r = self._emit_row(key, s)
+                if r is not None:
+                    rows.append(r)
+        return rows

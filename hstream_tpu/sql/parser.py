@@ -114,7 +114,16 @@ class Parser:
         return self.next().text
 
     def text_between(self, start: int, end: int) -> str:
-        return " ".join(t.text for t in self.toks[start:end])
+        # compact rendering: no spaces around ( ) , . so aggregate output
+        # names read like the SQL source ("SUM(temp)")
+        out: list[str] = []
+        for t in self.toks[start:end]:
+            if out and (t.text in (")", ",", ".", "(")
+                        or out[-1] in ("(", ".")):
+                out[-1] = out[-1] + t.text
+            else:
+                out.append(t.text)
+        return " ".join(out)
 
     # ---- statements ----
     def parse_stmt(self) -> ast.Statement:
@@ -301,6 +310,17 @@ class Parser:
         return ast.Select(items=items, source=source, join=join, where=where,
                           group_by=group_by, window=window, having=having,
                           emit_changes=emit_changes)
+
+    def parse_colname(self) -> Col:
+        t = self.next()
+        if t.kind not in ("IDENT", "RAWCOL"):
+            self.err("expected column name", t)
+        name = t.text
+        if self.at_sym(".") and self.peek(1).kind in ("IDENT", "RAWCOL"):
+            self.next()
+            field = self.ident("column")
+            return Col(field, stream=name)
+        return Col(name)
 
     def parse_select_item(self) -> ast.SelectItem:
         start = self.pos
