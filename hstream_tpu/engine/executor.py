@@ -488,8 +488,18 @@ class QueryExecutor:
                 if c in nulls:
                     nm |= nulls[c][:n]
             null_masks.append(nm)
+        # SQL NULL in a WHERE operand makes the predicate not-true: fold
+        # filter-column null masks into `valid` exactly like the row path.
+        valid = None
+        if self._filter_expr is not None and nulls is not None:
+            fm = np.zeros(n, dtype=np.bool_)
+            for c in columns_of(self._filter_expr):
+                if c in nulls:
+                    fm |= nulls[c][:n]
+            if fm.any():
+                valid = ~fm
         packed = lattice.pack_batch_host(
-            cap, n, key_ids, ts_rel64.astype(np.int32), None, cols,
+            cap, n, key_ids, ts_rel64.astype(np.int32), valid, cols,
             null_masks, self._layout)
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)

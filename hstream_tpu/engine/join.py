@@ -1,0 +1,285 @@
+"""Stream-stream interval JOIN execution.
+
+Reference semantics (hstream-processing Stream.hs:222-300 /
+joinStreamProcessor): each record is inserted into its side's
+timestamped KV store, then probed against the other side's store over
+[ts - within, ts + within]; matching pairs (equal join key) emit a
+joined record whose fields are the union of both sides qualified by
+stream name (genJoiner, Internal/Codegen.hs:62-67) and whose timestamp
+is max(ts1, ts2). The joined stream feeds the rest of the plan
+(filter -> window aggregate -> ...), exactly like the reference's
+merged-stream task DAG (Codegen.hs:253-266).
+
+Design: the join itself is host-side two-sided state (per-key sorted
+ts lists — the same per-record KV walk the reference does), while the
+downstream aggregation still runs as the jitted device lattice. Join
+state is pruned by within + downstream grace, bounding memory where the
+reference's in-memory store grows forever.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import replace
+from typing import Any, Mapping, Sequence
+
+from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
+from hstream_tpu.engine.plan import AggregateNode
+from hstream_tpu.engine.window import DEFAULT_GRACE_MS
+
+
+def split_on_condition(on: Expr, left_streams: set[str],
+                       right_streams: set[str]) -> tuple[list[Expr],
+                                                         list[Expr]]:
+    """Decompose `ON a.k1 = b.k2 [AND ...]` into per-side key-selector
+    expression lists (evaluated over each side's RAW rows, so
+    qualification is stripped). The reference's key selectors are
+    functions of one side's record (Stream.hs:224-230)."""
+    eqs: list[tuple[Expr, Expr]] = []
+
+    def walk(e: Expr) -> None:
+        if isinstance(e, BinOp) and e.op == "AND":
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, BinOp) and e.op == "=":
+            eqs.append((e.left, e.right))
+        else:
+            raise SQLCodegenError(
+                "JOIN ON must be a conjunction of equality comparisons")
+
+    walk(on)
+
+    def side_of(e: Expr) -> str:
+        streams = set()
+
+        def scan(x: Expr) -> None:
+            if isinstance(x, Col):
+                streams.add(x.stream)
+            elif isinstance(x, BinOp):
+                scan(x.left)
+                scan(x.right)
+            elif hasattr(x, "operand"):
+                scan(x.operand)
+
+        scan(e)
+        named = {s for s in streams if s is not None}
+        if named <= left_streams and named:
+            return "l"
+        if named <= right_streams and named:
+            return "r"
+        if not named:
+            raise SQLCodegenError(
+                "JOIN ON columns must be stream-qualified (s.col)")
+        raise SQLCodegenError(
+            f"JOIN ON side mixes streams {sorted(named)}")
+
+    def strip(e: Expr) -> Expr:
+        if isinstance(e, Col):
+            return Col(e.name)
+        if isinstance(e, BinOp):
+            return BinOp(e.op, strip(e.left), strip(e.right))
+        if hasattr(e, "operand"):
+            return type(e)(e.op, strip(e.operand))
+        return e
+
+    lks: list[Expr] = []
+    rks: list[Expr] = []
+    for a, b in eqs:
+        sa, sb = side_of(a), side_of(b)
+        if sa == sb:
+            raise SQLCodegenError("JOIN ON equality must relate both sides")
+        if sa == "l":
+            lks.append(strip(a))
+            rks.append(strip(b))
+        else:
+            lks.append(strip(b))
+            rks.append(strip(a))
+    return lks, rks
+
+
+class _SideStore:
+    """Per-side timestamped KV store: key -> (sorted ts list, rows list).
+    The reference's TimestampedKVStore tksPut/tksRange
+    (Processing/Store.hs)."""
+
+    def __init__(self) -> None:
+        self.by_key: dict[tuple, tuple[list[int], list[dict]]] = {}
+
+    def put(self, key: tuple, ts: int, row: dict) -> None:
+        tss, rows = self.by_key.setdefault(key, ([], []))
+        i = bisect.bisect_right(tss, ts)
+        tss.insert(i, ts)
+        rows.insert(i, row)
+
+    def range(self, key: tuple, lo: int, hi: int):
+        """Rows with lo <= ts <= hi for this key (tksRange)."""
+        ent = self.by_key.get(key)
+        if ent is None:
+            return []
+        tss, rows = ent
+        i = bisect.bisect_left(tss, lo)
+        j = bisect.bisect_right(tss, hi)
+        return list(zip(tss[i:j], rows[i:j]))
+
+    def prune(self, min_ts: int) -> None:
+        dead = []
+        for key, (tss, rows) in self.by_key.items():
+            i = bisect.bisect_left(tss, min_ts)
+            if i:
+                del tss[:i]
+                del rows[:i]
+            if not tss:
+                dead.append(key)
+        for key in dead:
+            del self.by_key[key]
+
+
+class JoinExecutor:
+    """Executes `SELECT ... FROM l [INNER|LEFT] JOIN r WITHIN(...) ON ...`.
+
+    API: process(rows, ts_ms, stream=<source name or alias>) — the task
+    runtime feeds records from BOTH streams through the one executor,
+    tagging each batch with its origin (the reference merges both
+    sources into one task, Codegen.hs:250-266). Joined rows feed the
+    inner (aggregate/stateless) executor built over the joined schema.
+    """
+
+    def __init__(self, plan, *, initial_keys: int = 1024,
+                 batch_capacity: int = 4096):
+        join = plan.join
+        self.plan = plan
+        self.left_name = plan.source
+        self.right_name = join.right.name
+        if self.right_name == self.left_name:
+            raise SQLCodegenError("self-join needs distinct aliases")
+        self.join_type = join.join_type
+        if self.join_type not in ("INNER", "JOIN"):
+            raise SQLCodegenError(
+                f"{self.join_type} JOIN not supported (INNER only, like "
+                "the reference's RJoinInner path)")
+        self.within = join.within.ms
+        self._aliases = {self.left_name: "l", self.right_name: "r"}
+        # aliases (AS x) route process(stream=) too
+        left_al = {self.left_name}
+        right_al = {self.right_name}
+        la = getattr(plan, "source_alias", None)
+        if la:
+            self._aliases[la] = "l"
+            left_al.add(la)
+        if join.right.alias:
+            self._aliases[join.right.alias] = "r"
+            right_al.add(join.right.alias)
+        self.left_keys, self.right_keys = split_on_condition(
+            join.on, left_al, right_al)
+
+        # retention: a future in-grace record can probe back `within`;
+        # grace defaults to the downstream window's (or the SQL default)
+        node = plan.node
+        grace = DEFAULT_GRACE_MS
+        if isinstance(node, AggregateNode) and node.window is not None:
+            grace = node.window.grace_ms
+        self.retention_ms = self.within + grace
+
+        self._stores = {"l": _SideStore(), "r": _SideStore()}
+        self.watermark: int = -1
+        self._inner = None
+        self._inner_plan = replace(plan, join=None)
+        self._initial_keys = initial_keys
+        self._batch_capacity = batch_capacity
+
+    # ---- joined-row construction -------------------------------------------
+
+    def _joined_row(self, lrow: Mapping[str, Any],
+                    rrow: Mapping[str, Any]) -> dict[str, Any]:
+        """Union of both sides, stream-qualified (genJoiner); bare names
+        kept as a convenience with left precedence."""
+        out = {}
+        for f, v in lrow.items():
+            out[f"{self.left_name}.{f}"] = v
+        for f, v in rrow.items():
+            out[f"{self.right_name}.{f}"] = v
+        for f, v in rrow.items():
+            out.setdefault(f, v)
+        for f, v in lrow.items():
+            out[f] = v
+        return out
+
+    def _key(self, exprs: list[Expr], row: Mapping[str, Any]):
+        try:
+            vals = tuple(eval_host(e, row) for e in exprs)
+        except (TypeError, KeyError):
+            return None
+        if any(v is None for v in vals):
+            return None
+        return vals
+
+    # ---- ingest ------------------------------------------------------------
+
+    def process(self, rows: Sequence[Mapping[str, Any]],
+                ts_ms: Sequence[int], stream: str | None = None
+                ) -> list[dict[str, Any]]:
+        if stream is None:
+            raise SQLCodegenError(
+                "JoinExecutor.process requires stream=<name or alias>: a "
+                "join consumes two streams and must know each batch's "
+                "origin")
+        side = self._aliases.get(stream)
+        if side is None:
+            raise SQLCodegenError(f"stream {stream!r} is not part of this "
+                                  f"join")
+        mine = self._stores[side]
+        other = self._stores["r" if side == "l" else "l"]
+        my_keys = self.left_keys if side == "l" else self.right_keys
+        joined: list[dict[str, Any]] = []
+        jts: list[int] = []
+        for row, ts in zip(rows, ts_ms):
+            ts = int(ts)
+            key = self._key(my_keys, row)
+            if key is None:
+                continue
+            mine.put(key, ts, dict(row))
+            for ots, orow in other.range(key, ts - self.within,
+                                         ts + self.within):
+                if side == "l":
+                    jrow = self._joined_row(row, orow)
+                else:
+                    jrow = self._joined_row(orow, row)
+                joined.append(jrow)
+                jts.append(max(ts, ots))
+        new_wm = max((int(t) for t in ts_ms), default=self.watermark)
+        if new_wm > self.watermark:
+            self.watermark = new_wm
+            cutoff = self.watermark - self.retention_ms
+            if cutoff > 0:
+                mine.prune(cutoff)
+                other.prune(cutoff)
+        if not joined:
+            return []
+        return self._inner_process(joined, jts)
+
+    def _inner_process(self, joined, jts):
+        if self._inner is None:
+            from hstream_tpu.sql.codegen import make_executor
+
+            self._inner = make_executor(
+                self._inner_plan, sample_rows=joined,
+                initial_keys=self._initial_keys,
+                batch_capacity=self._batch_capacity)
+        return self._inner.process(joined, jts)
+
+    # ---- drains (API parity with QueryExecutor) ----------------------------
+
+    def peek(self) -> list[dict[str, Any]]:
+        return [] if self._inner is None else self._inner.peek()
+
+    def close_due_windows(self) -> list[dict[str, Any]]:
+        if self._inner is None or not hasattr(self._inner,
+                                              "close_due_windows"):
+            return []
+        return self._inner.close_due_windows()
+
+    def block_until_ready(self) -> None:
+        if self._inner is not None and hasattr(self._inner,
+                                               "block_until_ready"):
+            self._inner.block_until_ready()

@@ -137,13 +137,22 @@ def build_step_fn(spec: LatticeSpec,
     n_per = spec.windows_per_record
     win = spec.window
 
-    def step(state, watermark, key_ids, ts, valid, cols):
+    def step(state, watermark, key_ids, ts, valid, cols, slot_valid=None):
+        # `slot_valid` (default: valid) masks the key-independent
+        # slot_start update separately: the sharded wrapper passes the
+        # pre-key-ownership mask here so every key shard computes the
+        # SAME slot_start and the replicated out-spec is actually true.
+        if slot_valid is None:
+            slot_valid = valid
         if filter_fn is not None:
-            valid = valid & filter_fn(cols)
+            f = filter_fn(cols)
+            valid = valid & f
+            slot_valid = slot_valid & f
 
         if win is None:
             starts = jnp.zeros((key_ids.shape[0], 1), jnp.int32)
             ok = valid[:, None]
+            ok_slot = slot_valid[:, None]
             slots = jnp.zeros_like(starts)
         else:
             advance, size, grace = win.advance_ms, win.size_ms, win.grace_ms
@@ -151,7 +160,9 @@ def build_step_fn(spec: LatticeSpec,
             offs = (jnp.arange(n_per, dtype=jnp.int32) * advance)[None, :]
             starts = latest[:, None] - offs                     # [B, n_per]
             late = (starts + (size + grace)) <= watermark
-            ok = valid[:, None] & ~late & (starts >= 0)
+            in_range = ~late & (starts >= 0)
+            ok = valid[:, None] & in_range
+            ok_slot = slot_valid[:, None] & in_range
             slots = jnp.mod(starts // advance, W)
 
         flat_k = jnp.where(ok, key_ids[:, None], K).reshape(-1)  # K = OOB -> drop
@@ -163,7 +174,8 @@ def build_step_fn(spec: LatticeSpec,
         out["count"] = state["count"].at[flat_k, flat_s].add(
             flat_ok.astype(jnp.int32), mode="drop")
         out["slot_start"] = state["slot_start"].at[
-            jnp.where(flat_ok, flat_s, W)].max(flat_starts, mode="drop")
+            jnp.where(ok_slot.reshape(-1), slots.reshape(-1), W)].max(
+            flat_starts, mode="drop")
         out["touched"] = state["touched"].at[flat_k, flat_s].set(
             True, mode="drop")
 

@@ -226,13 +226,16 @@ class SessionExecutor:
                 except (TypeError, KeyError):
                     continue
             key = tuple(row.get(c) for c in self.group_cols)
-            # late: would it merge only into closed territory?
-            if self.watermark >= 0 and ts + gap + grace <= self.watermark:
-                continue
             sess_list = self.sessions.setdefault(key, [])
             # find sessions overlapping [ts - gap, ts + gap]
             overl = [s for s in sess_list
                      if s.start - gap <= ts <= s.end + gap]
+            # Late-record policy (reference merge-on-overlap,
+            # SessionWindowedStream.hs:84-118): drop only when the record
+            # is past grace AND cannot merge into any still-open session.
+            if (not overl and self.watermark >= 0
+                    and ts + gap + grace <= self.watermark):
+                continue
             if overl:
                 merged = overl[0]
                 for s in overl[1:]:
@@ -271,11 +274,18 @@ class SessionExecutor:
         return out
 
     def close_due_sessions(self) -> list[dict[str, Any]]:
+        # A session may only close once no acceptable future record can
+        # still merge into it. Acceptable records have ts > wm-gap-grace
+        # (the in-grace gate) and merge into s when ts <= s.end + gap, so
+        # the session is safe to close when wm >= end + 2*gap + grace.
+        # The reference never eagerly deletes session state
+        # (SessionWindowedStream.hs:84-118); closing one gap-width later
+        # preserves its merge-on-overlap semantics while still emitting.
         gap, grace = self.window.gap_ms, self.window.grace_ms
         rows = []
         for key, sess_list in list(self.sessions.items()):
             due = [s for s in sess_list
-                   if s.end + gap + grace <= self.watermark]
+                   if s.end + 2 * gap + grace <= self.watermark]
             for s in due:
                 if not self.emit_changes:
                     rows.append(self._emit_row(key, s))

@@ -146,7 +146,11 @@ class ShardedLattice:
                 packed, layout, null_keys)
             kid = key_ids - key_offset()
             ok = valid & (kid >= 0) & (kid < Kl)
-            new = self._local_step(local, watermark, kid, ts, ok, cols)
+            # slot_valid = the pre-key-ownership mask: slot_start is
+            # key-independent, so every key shard must update it from ALL
+            # valid records for the replicated out-spec to hold.
+            new = self._local_step(local, watermark, kid, ts, ok, cols,
+                                   slot_valid=valid)
             return {k: v[None] for k, v in new.items()}
 
         # packed batch [rows, B]: rows replicated, records sharded on data

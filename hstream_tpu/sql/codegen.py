@@ -146,8 +146,54 @@ class _AggCollector:
         return e
 
 
+def _flatten_join_refs(sel: ast.Select) -> ast.Select:
+    """For JOIN queries, rewrite stream-qualified column refs
+    `s.col` -> flat `s.col` names: the joined rows built by
+    engine.join.JoinExecutor carry stream-qualified field names exactly
+    like the reference's genJoiner (Internal/Codegen.hs:62-67), so
+    downstream expressions address them as ordinary flat columns."""
+    refs = [sel.source, sel.join.right]
+    resolve: dict[str, str] = {}
+    for ref in refs:
+        resolve[ref.name] = ref.name
+        if ref.alias:
+            resolve[ref.alias] = ref.name
+
+    def flat(e):
+        if isinstance(e, Col):
+            if e.stream is None:
+                return e
+            name = resolve.get(e.stream)
+            if name is None:
+                raise SQLCodegenError(
+                    f"unknown stream qualifier {e.stream!r}")
+            return Col(f"{name}.{e.name}")
+        if isinstance(e, BinOp):
+            return BinOp(e.op, flat(e.left), flat(e.right))
+        if isinstance(e, UnOp):
+            return UnOp(e.op, flat(e.operand))
+        if isinstance(e, ast.SetFunc):
+            return ast.SetFunc(e.kind,
+                               flat(e.arg) if e.arg is not None else None,
+                               e.arg2, e.text)
+        return e
+
+    items = None
+    if sel.items is not None:
+        items = [ast.SelectItem(flat(i.expr), i.alias, i.text)
+                 for i in sel.items]
+    return ast.Select(
+        items=items, source=sel.source, join=sel.join,
+        where=flat(sel.where) if sel.where is not None else None,
+        group_by=[flat(g) for g in sel.group_by], window=sel.window,
+        having=flat(sel.having) if sel.having is not None else None,
+        emit_changes=sel.emit_changes)
+
+
 def lower_select(sel: ast.Select, sql: str = "") -> plans.SelectPlan:
     """SELECT -> engine plan (aggregate or stateless)."""
+    if sel.join is not None:
+        sel = _flatten_join_refs(sel)
     infer = _SchemaInference()
     if sel.where is not None:
         infer.walk(sel.where)
@@ -188,6 +234,15 @@ def lower_select(sel: ast.Select, sql: str = "") -> plans.SelectPlan:
             if not (bare_agg or plain_group):
                 natural = False
             projected.append((name, rewritten))
+        # Explicit projection must still carry the group-key columns:
+        # the reference's emitted row always includes the key (the
+        # aggregate output is keyed by it — Codegen.hs:479-521), so
+        # `SELECT COUNT(*) AS c ... GROUP BY city` emits city too.
+        if not natural:
+            covered = {e.name for _, e in projected if isinstance(e, Col)}
+            key_proj = [(g, Col(g)) for g in group_names
+                        if g not in covered]
+            projected = key_proj + projected
         having = None
         if sel.having is not None:
             having = coll.rewrite(sel.having)
@@ -213,6 +268,7 @@ def lower_select(sel: ast.Select, sql: str = "") -> plans.SelectPlan:
         schema_req=plans.SchemaRequirement(inferred=dict(infer.types)),
         emit_changes=sel.emit_changes,
         join=sel.join,
+        source_alias=sel.source.alias,
     )
 
 
@@ -333,6 +389,16 @@ def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
 
     `sample_rows` refine schema inference (bind_schema). With `mesh`, the
     aggregation lattice is sharded over it (hstream_tpu.parallel)."""
+    if plan.join is not None:
+        if mesh is not None:
+            raise SQLCodegenError(
+                "sharded execution of JOIN plans is not supported yet")
+        from hstream_tpu.engine.join import JoinExecutor
+
+        # schema inference for the inner executor uses the first JOINED
+        # batch (caller sample rows are single-stream shaped)
+        return JoinExecutor(plan, initial_keys=initial_keys,
+                            batch_capacity=batch_capacity)
     node = plan.node
     if isinstance(node, AggregateNode):
         schema = bind_schema(plan, sample_rows)

@@ -119,7 +119,7 @@ class Parser:
         out: list[str] = []
         for t in self.toks[start:end]:
             if out and (t.text in (")", ",", ".", "(")
-                        or out[-1] in ("(", ".")):
+                        or out[-1].endswith(("(", "."))):
                 out[-1] = out[-1] + t.text
             else:
                 out.append(t.text)

@@ -35,6 +35,7 @@ class SelectPlan:
     schema_req: SchemaRequirement
     emit_changes: bool
     join: ast.JoinClause | None = None
+    source_alias: str | None = None   # FROM <source> AS <alias>
 
 
 @dataclass(frozen=True)

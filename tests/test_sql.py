@@ -155,6 +155,19 @@ def test_sql_stateless_select():
     assert out == [{"t": 20.0, "city": "la"}]
 
 
+def test_sql_aliased_group_key_not_duplicated():
+    rows = [{"city": "sf", "temp": 1.0}, {"city": "sf", "temp": 2.0}]
+    closer = [{"city": "xx", "temp": 0.0}]
+    _, out = run_sql(
+        "SELECT city AS town, COUNT(*) AS c FROM weather "
+        "GROUP BY city, TUMBLING (INTERVAL 10 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;",
+        [(rows, [BASE, BASE + 1]), (closer, [BASE + 20_000])])
+    sf = [r for r in out if r.get("town") == "sf"]
+    assert sf and sf[-1]["c"] == 2
+    assert "city" not in sf[-1]  # alias renames, no duplicate key column
+
+
 def test_sql_string_filter_on_device():
     rows = [{"city": "sf", "temp": 1.0}, {"city": "la", "temp": 1.0},
             {"city": "sf", "temp": 1.0}]
