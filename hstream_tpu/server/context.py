@@ -1,0 +1,65 @@
+"""ServerContext: the one object every handler reaches through.
+
+Reference: `ServerContext` bundles the LD client, ZK handle, and the
+MVar maps of running queries / connectors / subscriptions
+(Handler/Common.hs:85-115). Here it bundles the log store, stream
+namespace, checkpoint store, metadata persistence, view registry,
+subscription registry and the running-task maps.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hstream_tpu.server.persistence import (
+    MemPersistence,
+    Persistence,
+    StorePersistence,
+)
+from hstream_tpu.server.subscriptions import SubscriptionRegistry
+from hstream_tpu.server.views import ViewRegistry
+from hstream_tpu.store.api import LogStore
+from hstream_tpu.store.checkpoint import LogCheckpointStore
+from hstream_tpu.store.streams import StreamApi
+
+
+class ServerContext:
+    def __init__(self, store: LogStore, *,
+                 persistence: Persistence | None = None,
+                 host: str = "127.0.0.1", port: int = 6570,
+                 server_id: int = 1, durable_meta: bool = True):
+        self.store = store
+        self.streams = StreamApi(store)
+        self.streams.ensure_checkpoint_log()
+        self.ckp_store = LogCheckpointStore(store)
+        if persistence is None:
+            persistence = (StorePersistence(store) if durable_meta
+                           else MemPersistence())
+        self.persistence = persistence
+        self.views = ViewRegistry()
+        self.subscriptions = SubscriptionRegistry()
+        # query_id -> QueryTask; connector_id -> ConnectorTask
+        self.running_queries: dict[str, object] = {}
+        self.running_connectors: dict[str, object] = {}
+        self.lock = threading.Lock()
+        self.host = host
+        self.port = port
+        self.server_id = server_id
+        from hstream_tpu.stats import StatsHolder
+
+        self.stats = StatsHolder()
+
+    def shutdown(self) -> None:
+        for task in list(self.running_queries.values()):
+            try:
+                task.stop()
+            except Exception:
+                pass
+        for task in list(self.running_connectors.values()):
+            try:
+                task.stop()
+            except Exception:
+                pass
+        for rt in self.subscriptions.list():
+            rt.shutdown()
+        self.store.close()

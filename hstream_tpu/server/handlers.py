@@ -1,0 +1,707 @@
+"""The HStreamApi handler table: all 35 RPCs.
+
+Reference: `handlers` wires the full service (Handler.hs:96-174); stream
+CRUD + append at Handler.hs:187-231; `executeQueryHandler` dispatches
+one-shot plans incl. SelectView slicing (Handler.hs:259-346);
+`executePushQueryHandler` = codegen -> temp sink stream -> persist ->
+fork task -> stream Structs to the client (Handler.hs:349-415);
+subscription machinery at Handler.hs:420-935. Exceptions map to gRPC
+statuses like `defaultExceptionHandle` (Server/Exception.hs:27-50).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Iterable
+
+import grpc
+from google.protobuf import empty_pb2, struct_pb2
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.common.errors import (
+    HStreamError,
+    QueryNotFound,
+    ServerError,
+    StreamNotFound,
+)
+from hstream_tpu.common.idgen import gen_unique
+from hstream_tpu.common.logger import get_logger
+from hstream_tpu.connectors import ConnectorTask, make_sink
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server.persistence import (
+    QUERY_PUSH,
+    QUERY_STREAM,
+    QUERY_VIEW,
+    ConnectorInfo,
+    QueryInfo,
+    TaskStatus,
+    now_ms,
+)
+from hstream_tpu.server.subscriptions import RecId
+from hstream_tpu.server.tasks import QueryTask, stream_sink
+from hstream_tpu.server.views import Materialization, serve_select_view
+from hstream_tpu.sql import plans
+from hstream_tpu.sql.codegen import explain_text, stream_codegen
+from hstream_tpu.store.api import LSN_MIN, DataBatch
+from hstream_tpu.store.checkpoint import CheckpointedReader
+from hstream_tpu.store.streams import StreamType
+
+log = get_logger("server")
+
+
+def unary(fn):
+    @functools.wraps(fn)
+    def wrapped(self, request, context):
+        try:
+            return fn(self, request, context)
+        except HStreamError as e:
+            context.abort(e.grpc_status, str(e) or type(e).__name__)
+        except grpc.RpcError:
+            raise
+        except Exception as e:  # noqa: BLE001 — boundary mapping
+            log.exception("handler %s failed", fn.__name__)
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    return wrapped
+
+
+def streaming(fn):
+    @functools.wraps(fn)
+    def wrapped(self, request, context):
+        try:
+            yield from fn(self, request, context)
+        except HStreamError as e:
+            context.abort(e.grpc_status, str(e) or type(e).__name__)
+        except grpc.RpcError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log.exception("handler %s failed", fn.__name__)
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+
+    return wrapped
+
+
+def _struct(row: dict[str, Any]) -> struct_pb2.Struct:
+    return rec.dict_to_struct(row)
+
+
+class HStreamApiServicer:
+    def __init__(self, ctx: ServerContext):
+        self.ctx = ctx
+
+    # ---- misc ---------------------------------------------------------------
+
+    @unary
+    def Echo(self, request, context):
+        return pb.EchoResponse(msg=request.msg)
+
+    # ---- streams ------------------------------------------------------------
+
+    @unary
+    def CreateStream(self, request, context):
+        self.ctx.streams.create_stream(
+            request.stream_name,
+            replication_factor=max(request.replication_factor, 1))
+        return request
+
+    @unary
+    def DeleteStream(self, request, context):
+        self.ctx.streams.delete_stream(request.stream_name)
+        return empty_pb2.Empty()
+
+    @unary
+    def ListStreams(self, request, context):
+        out = pb.ListStreamsResponse()
+        for name in self.ctx.streams.find_streams():
+            meta = self.ctx.streams.stream_meta(name)
+            out.streams.append(pb.Stream(
+                stream_name=name,
+                replication_factor=meta.get("replication_factor", 1)))
+        return out
+
+    @unary
+    def Append(self, request, context):
+        ctx = self.ctx
+        logid = ctx.streams.get_logid(request.stream_name)
+        now = now_ms()
+        payloads = []
+        nbytes = 0
+        for r in request.records:
+            if not r.header.publish_time_ms:
+                r.header.publish_time_ms = now
+            data = r.SerializeToString()
+            payloads.append(data)
+            nbytes += len(data)
+        if not payloads:
+            raise ServerError("empty append")
+        lsn = ctx.store.append_batch(logid, payloads)
+        ctx.stats.note_append(request.stream_name, len(payloads), nbytes)
+        out = pb.AppendResponse(stream_name=request.stream_name)
+        for i in range(len(payloads)):
+            out.record_ids.append(pb.RecordId(batch_id=lsn, batch_index=i))
+        return out
+
+    @unary
+    def CreateQueryStream(self, request, context):
+        sql = request.query_statement
+        plan = stream_codegen(sql)
+        if isinstance(plan, plans.SelectPlan):
+            select = plan
+        elif isinstance(plan, plans.CreateBySelectPlan):
+            select = plan.select
+        else:
+            raise ServerError("CreateQueryStream needs a SELECT statement")
+        name = request.query_stream.stream_name
+        self.ctx.streams.create_stream(
+            name,
+            replication_factor=max(request.query_stream.replication_factor,
+                                   1))
+        info = self._launch_query(select, sql, QUERY_STREAM, sink_stream=name)
+        return pb.CreateQueryStreamResponse(
+            query_stream=request.query_stream,
+            stream_query=self._query_pb(info))
+
+    # ---- SQL ----------------------------------------------------------------
+
+    @streaming
+    def ExecutePushQuery(self, request, context):
+        """codegen -> temp sink stream -> fork task -> stream Structs
+        (Handler.hs:349-415)."""
+        ctx = self.ctx
+        plan = stream_codegen(request.query_text)
+        if not isinstance(plan, plans.SelectPlan) or not plan.emit_changes:
+            raise ServerError(
+                "ExecutePushQuery expects SELECT ... EMIT CHANGES")
+        if not ctx.streams.stream_exists(plan.source):
+            raise StreamNotFound(plan.source)
+        query_id = f"q{gen_unique()}"
+        sink_name = query_id
+        ctx.streams.create_stream(sink_name, stream_type=StreamType.TEMP)
+        info = self._launch_query(plan, request.query_text, QUERY_PUSH,
+                                  sink_stream=sink_name,
+                                  sink_type=StreamType.TEMP,
+                                  query_id=query_id)
+        task = ctx.running_queries.get(query_id)
+
+        def cleanup():
+            # handlePushQueryCanceled (Handler.hs:376-377)
+            if task is not None:
+                task.stop()
+            try:
+                ctx.persistence.set_query_status(query_id,
+                                                 TaskStatus.TERMINATED)
+            except Exception:
+                pass
+
+        context.add_callback(cleanup)
+        sink_logid = ctx.streams.get_logid(sink_name, StreamType.TEMP)
+        reader = ctx.store.new_reader()
+        reader.set_timeout(100)
+        reader.start_reading(sink_logid, LSN_MIN)
+        while context.is_active():
+            try:
+                info_now = ctx.persistence.get_query(query_id)
+            except QueryNotFound:
+                break
+            if info_now.status in (TaskStatus.TERMINATED,
+                                   TaskStatus.CONNECTION_ABORT):
+                break
+            for item in reader.read(256):
+                if not isinstance(item, DataBatch):
+                    continue
+                for payload in item.payloads:
+                    record = rec.parse_record(payload)
+                    s = rec.payload_to_struct(record)
+                    if s is not None:
+                        yield s
+
+    @unary
+    def ExecuteQuery(self, request, context):
+        plan = stream_codegen(request.stmt_text)
+        rows = self._execute_plan(plan, request.stmt_text)
+        out = pb.CommandQueryResponse()
+        for row in rows:
+            out.result_set.append(_struct(row))
+        return out
+
+    # ---- query lifecycle ----------------------------------------------------
+
+    @unary
+    def CreateQuery(self, request, context):
+        plan = stream_codegen(request.query_text)
+        if not isinstance(plan, plans.SelectPlan) or not plan.emit_changes:
+            raise ServerError("CreateQuery expects SELECT ... EMIT CHANGES")
+        query_id = request.id or f"q{gen_unique()}"
+        sink_name = query_id
+        self.ctx.streams.create_stream(sink_name,
+                                       stream_type=StreamType.TEMP)
+        info = self._launch_query(plan, request.query_text, QUERY_PUSH,
+                                  sink_stream=sink_name,
+                                  sink_type=StreamType.TEMP,
+                                  query_id=query_id)
+        return self._query_pb(info)
+
+    @unary
+    def ListQueries(self, request, context):
+        out = pb.ListQueriesResponse()
+        for info in self.ctx.persistence.get_queries():
+            if info.query_type == QUERY_VIEW:
+                continue
+            out.queries.append(self._query_pb(info))
+        return out
+
+    @unary
+    def GetQuery(self, request, context):
+        return self._query_pb(self.ctx.persistence.get_query(request.id))
+
+    @unary
+    def TerminateQueries(self, request, context):
+        ids = ([q.query_id for q in self.ctx.persistence.get_queries()
+                if q.query_type != QUERY_VIEW]
+               if request.all else list(request.query_ids))
+        done = []
+        for qid in ids:
+            try:
+                self._terminate_query(qid)
+                done.append(qid)
+            except QueryNotFound:
+                if not request.all:
+                    raise
+        return pb.TerminateQueriesResponse(query_ids=done)
+
+    @unary
+    def DeleteQuery(self, request, context):
+        info = self.ctx.persistence.get_query(request.id)
+        self._terminate_query(request.id)
+        self.ctx.persistence.remove_query(request.id)
+        self.ctx.ckp_store.remove(f"query-{request.id}")
+        if info.query_type == QUERY_PUSH and info.sink:
+            try:
+                self.ctx.streams.delete_stream(info.sink, StreamType.TEMP)
+            except StreamNotFound:
+                pass
+        return empty_pb2.Empty()
+
+    @unary
+    def RestartQuery(self, request, context):
+        """The reference leaves this unimplemented
+        (Handler/Query.hs:152-160); here a terminated query resumes from
+        its read checkpoints."""
+        ctx = self.ctx
+        info = ctx.persistence.get_query(request.id)
+        if request.id in ctx.running_queries:
+            raise ServerError(f"query {request.id} is already running")
+        plan = stream_codegen(info.sql)
+        if info.query_type == QUERY_VIEW:
+            self._start_view_task(info, plan)
+        else:
+            stype = (StreamType.TEMP if info.query_type == QUERY_PUSH
+                     else StreamType.STREAM)
+            sink = stream_sink(ctx, info.sink, stype)
+            task = QueryTask(ctx, info, plan
+                             if isinstance(plan, plans.SelectPlan)
+                             else plan.select, sink)
+            ctx.running_queries[info.query_id] = task
+            task.start()
+        ctx.persistence.set_query_status(info.query_id, TaskStatus.RUNNING)
+        return empty_pb2.Empty()
+
+    # ---- subscriptions ------------------------------------------------------
+
+    @unary
+    def CreateSubscription(self, request, context):
+        if not self.ctx.streams.stream_exists(request.stream_name):
+            raise StreamNotFound(request.stream_name)
+        self.ctx.subscriptions.create(self.ctx, request)
+        return request
+
+    @unary
+    def Subscribe(self, request, context):
+        self.ctx.subscriptions.get(request.subscription_id)
+        return pb.SubscribeResponse(
+            subscription_id=request.subscription_id)
+
+    @unary
+    def ListSubscriptions(self, request, context):
+        out = pb.ListSubscriptionsResponse()
+        for rt in self.ctx.subscriptions.list():
+            out.subscription.append(rt.meta)
+        return out
+
+    @unary
+    def CheckSubscriptionExist(self, request, context):
+        return pb.CheckSubscriptionExistResponse(
+            exists=self.ctx.subscriptions.exists(request.subscription_id))
+
+    @unary
+    def DeleteSubscription(self, request, context):
+        self.ctx.subscriptions.remove(request.subscription_id)
+        self.ctx.ckp_store.remove(
+            f"subscription-{request.subscription_id}")
+        return empty_pb2.Empty()
+
+    @unary
+    def SendConsumerHeartbeat(self, request, context):
+        # liveness no-op, like the reference (Handler.hs:610-617)
+        return pb.ConsumerHeartbeatResponse(
+            subscription_id=request.subscription_id)
+
+    @unary
+    def Fetch(self, request, context):
+        rt = self.ctx.subscriptions.get(request.subscription_id)
+        got = rt.fetch(timeout_ms=int(request.timeout_ms),
+                       max_size=int(request.max_size) or 256)
+        out = pb.FetchResponse()
+        nbytes = 0
+        for rid, payload in got:
+            out.received_records.append(pb.ReceivedRecord(
+                record_id=pb.RecordId(batch_id=rid.lsn,
+                                      batch_index=rid.idx),
+                record=payload))
+            nbytes += len(payload)
+        if got:
+            self.ctx.stats.note_read(rt.meta.stream_name, len(got), nbytes)
+        return out
+
+    @unary
+    def Acknowledge(self, request, context):
+        rt = self.ctx.subscriptions.get(request.subscription_id)
+        rt.ack([RecId(a.batch_id, a.batch_index) for a in request.ack_ids])
+        return empty_pb2.Empty()
+
+    @streaming
+    def StreamingFetch(self, request_iterator, context):
+        """BiDi fetch with consumer round-robin (Handler.hs:720-935):
+        the first request registers the consumer, subsequent requests
+        carry acks."""
+        try:
+            first = next(iter(request_iterator))
+        except StopIteration:
+            return
+        rt = self.ctx.subscriptions.get(first.subscription_id)
+        consumer = rt.register_consumer(first.consumer_name or "consumer")
+        if first.ack_ids:
+            rt.ack([RecId(a.batch_id, a.batch_index)
+                    for a in first.ack_ids])
+
+        def drain_acks():
+            try:
+                for req in request_iterator:
+                    if req.ack_ids:
+                        rt.ack([RecId(a.batch_id, a.batch_index)
+                                for a in req.ack_ids])
+            except Exception:
+                pass
+            finally:
+                consumer.alive = False
+
+        t = threading.Thread(target=drain_acks, daemon=True)
+        t.start()
+        try:
+            import queue as _q
+
+            while context.is_active() and consumer.alive:
+                try:
+                    batch = consumer.queue.get(timeout=0.1)
+                except _q.Empty:
+                    continue
+                resp = pb.StreamingFetchResponse()
+                for rid, payload in batch:
+                    resp.received_records.append(pb.ReceivedRecord(
+                        record_id=pb.RecordId(batch_id=rid.lsn,
+                                              batch_index=rid.idx),
+                        record=payload))
+                yield resp
+        finally:
+            rt.unregister_consumer(consumer)
+
+    # ---- connectors ---------------------------------------------------------
+
+    @unary
+    def CreateSinkConnector(self, request, context):
+        plan = stream_codegen(request.config)
+        if not isinstance(plan, plans.CreateSinkConnectorPlan):
+            raise ServerError(
+                "config must be a CREATE SINK CONNECTOR statement")
+        cid = request.id or plan.name
+        info = self._create_connector(cid, request.config, plan)
+        return self._connector_pb(info)
+
+    @unary
+    def ListConnectors(self, request, context):
+        out = pb.ListConnectorsResponse()
+        for info in self.ctx.persistence.get_connectors():
+            out.connectors.append(self._connector_pb(info))
+        return out
+
+    @unary
+    def GetConnector(self, request, context):
+        return self._connector_pb(
+            self.ctx.persistence.get_connector(request.id))
+
+    @unary
+    def DeleteConnector(self, request, context):
+        self._terminate_connector(request.id)
+        self.ctx.persistence.remove_connector(request.id)
+        self.ctx.ckp_store.remove(f"connector-{request.id}")
+        return empty_pb2.Empty()
+
+    @unary
+    def RestartConnector(self, request, context):
+        ctx = self.ctx
+        info = ctx.persistence.get_connector(request.id)
+        if request.id in ctx.running_connectors:
+            raise ServerError(f"connector {request.id} is already running")
+        plan = stream_codegen(info.sql)
+        self._start_connector_task(info, plan)
+        return empty_pb2.Empty()
+
+    @unary
+    def TerminateConnector(self, request, context):
+        self._terminate_connector(request.id)
+        return empty_pb2.Empty()
+
+    # ---- views --------------------------------------------------------------
+
+    @unary
+    def CreateView(self, request, context):
+        plan = stream_codegen(request.sql)
+        if not isinstance(plan, plans.CreateViewPlan):
+            raise ServerError("sql must be CREATE VIEW ... AS SELECT ...")
+        info = self._create_view(plan, request.sql)
+        return self._view_pb(info)
+
+    @unary
+    def ListViews(self, request, context):
+        out = pb.ListViewsResponse()
+        for info in self.ctx.persistence.get_queries():
+            if info.query_type == QUERY_VIEW:
+                out.views.append(self._view_pb(info))
+        return out
+
+    @unary
+    def GetView(self, request, context):
+        info = self.ctx.persistence.get_query(f"view-{request.view_id}")
+        return self._view_pb(info)
+
+    @unary
+    def DeleteView(self, request, context):
+        self._drop_view(request.view_id)
+        return empty_pb2.Empty()
+
+    # ---- cluster ------------------------------------------------------------
+
+    @unary
+    def ListNodes(self, request, context):
+        return pb.ListNodesResponse(nodes=[self._node_pb()])
+
+    @unary
+    def GetNode(self, request, context):
+        if request.id != self.ctx.server_id:
+            raise ServerError(f"unknown node {request.id}")
+        return self._node_pb()
+
+    # ---- plan execution (executeQueryHandler dispatch) ----------------------
+
+    def _execute_plan(self, plan, sql: str) -> list[dict[str, Any]]:
+        ctx = self.ctx
+        if isinstance(plan, plans.CreatePlan):
+            ctx.streams.create_stream(plan.stream)
+            return [{"stream": plan.stream, "created": True}]
+        if isinstance(plan, plans.CreateBySelectPlan):
+            ctx.streams.create_stream(plan.stream)
+            info = self._launch_query(plan.select, sql, QUERY_STREAM,
+                                      sink_stream=plan.stream)
+            return [{"stream": plan.stream, "query": info.query_id}]
+        if isinstance(plan, plans.CreateViewPlan):
+            info = self._create_view(plan, sql)
+            return [{"view": plan.view, "query": info.query_id}]
+        if isinstance(plan, plans.CreateSinkConnectorPlan):
+            info = self._create_connector(plan.name, sql, plan)
+            return [{"connector": info.connector_id}]
+        if isinstance(plan, plans.InsertPlan):
+            logid = ctx.streams.get_logid(plan.stream)
+            if plan.payload is not None:
+                record = rec.build_record(plan.payload)
+            else:
+                record = rec.build_record(plan.raw_payload or b"")
+            data = record.SerializeToString()
+            lsn = ctx.store.append(logid, data)
+            ctx.stats.note_append(plan.stream, 1, len(data))
+            return [{"stream": plan.stream, "lsn": lsn}]
+        if isinstance(plan, plans.ShowPlan):
+            return self._show(plan.what)
+        if isinstance(plan, plans.DropPlan):
+            return self._drop(plan)
+        if isinstance(plan, plans.TerminatePlan):
+            if plan.query_id is None:
+                ids = [q.query_id for q in ctx.persistence.get_queries()
+                       if q.query_type != QUERY_VIEW]
+            else:
+                ids = [plan.query_id]
+            for qid in ids:
+                self._terminate_query(qid)
+            return [{"terminated": qid} for qid in ids]
+        if isinstance(plan, plans.ExplainPlan):
+            return [{"explain": plan.text}]
+        if isinstance(plan, plans.SelectViewPlan):
+            mat = ctx.views.get(plan.view)
+            return serve_select_view(mat, plan.select)
+        if isinstance(plan, plans.SelectPlan):
+            raise ServerError(
+                "push queries (EMIT CHANGES) go through ExecutePushQuery")
+        raise ServerError(f"cannot execute {type(plan).__name__}")
+
+    def _show(self, what: str) -> list[dict[str, Any]]:
+        ctx = self.ctx
+        if what == "STREAMS":
+            return [{"stream": n} for n in ctx.streams.find_streams()]
+        if what == "VIEWS":
+            return [{"view": n} for n in ctx.views.names()]
+        if what == "QUERIES":
+            return [{"id": q.query_id, "status": q.status, "sql": q.sql}
+                    for q in ctx.persistence.get_queries()
+                    if q.query_type != QUERY_VIEW]
+        if what == "CONNECTORS":
+            return [{"id": c.connector_id, "status": c.status}
+                    for c in ctx.persistence.get_connectors()]
+        raise ServerError(f"SHOW {what} unsupported")
+
+    def _drop(self, plan: plans.DropPlan) -> list[dict[str, Any]]:
+        ctx = self.ctx
+        try:
+            if plan.what == "STREAM":
+                ctx.streams.delete_stream(plan.name)
+            elif plan.what == "VIEW":
+                self._drop_view(plan.name)
+            elif plan.what == "CONNECTOR":
+                self._terminate_connector(plan.name)
+                ctx.persistence.remove_connector(plan.name)
+            else:
+                raise ServerError(f"DROP {plan.what} unsupported")
+        except HStreamError:
+            if not plan.if_exists:
+                raise
+        return [{"dropped": plan.name}]
+
+    # ---- task helpers -------------------------------------------------------
+
+    def _launch_query(self, plan: plans.SelectPlan, sql: str, qtype: str,
+                      *, sink_stream: str,
+                      sink_type: StreamType = StreamType.STREAM,
+                      query_id: str | None = None) -> QueryInfo:
+        ctx = self.ctx
+        query_id = query_id or f"q{gen_unique()}"
+        info = QueryInfo(query_id=query_id, sql=sql,
+                         created_time_ms=now_ms(), query_type=qtype,
+                         status=TaskStatus.CREATED, sink=sink_stream)
+        ctx.persistence.insert_query(info)
+        task = QueryTask(ctx, info, plan,
+                         stream_sink(ctx, sink_stream, sink_type))
+        ctx.running_queries[query_id] = task
+        task.start()
+        return info
+
+    def _terminate_query(self, query_id: str) -> None:
+        ctx = self.ctx
+        ctx.persistence.get_query(query_id)  # raises if unknown
+        task = ctx.running_queries.pop(query_id, None)
+        if task is not None:
+            task.stop()
+        ctx.persistence.set_query_status(query_id, TaskStatus.TERMINATED)
+
+    def _create_view(self, plan: plans.CreateViewPlan,
+                     sql: str) -> QueryInfo:
+        ctx = self.ctx
+        query_id = f"view-{plan.view}"
+        info = QueryInfo(query_id=query_id, sql=sql,
+                         created_time_ms=now_ms(), query_type=QUERY_VIEW,
+                         status=TaskStatus.CREATED, sink=plan.view)
+        ctx.persistence.insert_query(info)
+        self._start_view_task(info, plan)
+        return info
+
+    def _start_view_task(self, info: QueryInfo, plan) -> None:
+        ctx = self.ctx
+        select = plan.select if isinstance(plan, plans.CreateViewPlan) \
+            else plan
+        mat = Materialization()
+        task = QueryTask(ctx, info, select, mat.add_closed)
+        mat.task = task
+        ctx.views.register(info.sink, mat)
+        ctx.running_queries[info.query_id] = task
+        task.start()
+
+    def _drop_view(self, view: str) -> None:
+        ctx = self.ctx
+        ctx.views.get(view)  # raises if unknown
+        query_id = f"view-{view}"
+        task = ctx.running_queries.pop(query_id, None)
+        if task is not None:
+            task.stop()
+        ctx.views.remove(view)
+        try:
+            ctx.persistence.remove_query(query_id)
+        except QueryNotFound:
+            pass
+
+    def _create_connector(self, cid: str, sql: str,
+                          plan: plans.CreateSinkConnectorPlan
+                          ) -> ConnectorInfo:
+        ctx = self.ctx
+        if plan.if_not_exist:
+            try:
+                return ctx.persistence.get_connector(cid)
+            except HStreamError:
+                pass
+        info = ConnectorInfo(connector_id=cid, sql=sql,
+                             created_time_ms=now_ms(),
+                             status=TaskStatus.CREATED)
+        ctx.persistence.insert_connector(info)
+        self._start_connector_task(info, plan)
+        return info
+
+    def _start_connector_task(self, info: ConnectorInfo, plan) -> None:
+        ctx = self.ctx
+        options = plan.options
+        source = options.get("STREAM")
+        if not source:
+            raise ServerError(
+                "connector options need STREAM (the source stream)")
+        sink = make_sink(ctx, options)
+        task = ConnectorTask(ctx, info.connector_id, source, sink)
+        ctx.running_connectors[info.connector_id] = task
+        task.start()
+
+    def _terminate_connector(self, cid: str) -> None:
+        ctx = self.ctx
+        ctx.persistence.get_connector(cid)
+        task = ctx.running_connectors.pop(cid, None)
+        if task is not None:
+            task.stop()
+        ctx.persistence.set_connector_status(cid, TaskStatus.TERMINATED)
+
+    # ---- pb builders --------------------------------------------------------
+
+    def _query_pb(self, info: QueryInfo) -> pb.Query:
+        return pb.Query(id=info.query_id, status=info.status,
+                        created_time_ms=info.created_time_ms,
+                        query_text=info.sql)
+
+    def _connector_pb(self, info: ConnectorInfo) -> pb.Connector:
+        return pb.Connector(id=info.connector_id, status=info.status,
+                            created_time_ms=info.created_time_ms,
+                            config=info.sql)
+
+    def _view_pb(self, info: QueryInfo) -> pb.View:
+        return pb.View(view_id=info.sink, status=info.status,
+                       created_time_ms=info.created_time_ms, sql=info.sql)
+
+    def _node_pb(self) -> pb.Node:
+        ctx = self.ctx
+        return pb.Node(id=ctx.server_id, address=ctx.host, port=ctx.port,
+                       roles=["server"], status="Running")

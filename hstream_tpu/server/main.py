@@ -1,0 +1,69 @@
+"""Server boot: flags -> store -> context -> gRPC serve.
+
+Reference: hstream/app/server.hs:36-149 (optparse flags; boot = logger ->
+store client -> init checkpoint log -> gRPC event loop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+from concurrent import futures
+
+import grpc
+
+from hstream_tpu.common.logger import get_logger
+from hstream_tpu.proto.rpc import add_hstream_api_to_server
+from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server.handlers import HStreamApiServicer
+from hstream_tpu.store import open_store
+
+log = get_logger("main")
+
+
+def serve(host: str = "127.0.0.1", port: int = 6570,
+          store_uri: str = "mem://", *, max_workers: int = 32
+          ) -> tuple[grpc.Server, ServerContext]:
+    """Start a server; returns (grpc_server, ctx). Caller owns shutdown."""
+    store = open_store(store_uri)
+    ctx = ServerContext(store, host=host, port=port)
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 64 * 1024 * 1024)])
+    add_hstream_api_to_server(HStreamApiServicer(ctx), server)
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"cannot bind {host}:{port}")
+    ctx.port = bound
+    server.start()
+    log.info("hstream-tpu server listening on %s:%d (store %s)",
+             host, bound, store_uri)
+    return server, ctx
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser("hstream-tpu-server")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6570)
+    ap.add_argument("--store", default="mem://",
+                    help="mem:// or a directory path for the native "
+                         "durable store")
+    ap.add_argument("--workers", type=int, default=32)
+    args = ap.parse_args(argv)
+    server, ctx = serve(args.host, args.port, args.store,
+                        max_workers=args.workers)
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        stop["flag"] = True
+        server.stop(grace=2)
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    server.wait_for_termination()
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Managed continuous-query tasks.
+
+The reference runs each continuous query as a forked green thread: a
+checkpointed reader polls the source stream(s), every record walks the
+processor DAG, and sink processors append results downstream
+(runTaskWrapper, Handler/Common.hs:169-180; runTask, Processor.hs:99-144).
+
+Here a task is one daemon thread per query driving the batched engine:
+read a chunk from the checkpointed reader -> decode JSON records ->
+executor.process (the jitted lattice step) -> emit rows to the sink
+callback -> commit read checkpoints. Joins read both streams through the
+same reader and route batches by origin stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.common.logger import get_logger
+from hstream_tpu.server.persistence import QueryInfo, TaskStatus
+from hstream_tpu.store.api import LSN_MIN, DataBatch
+from hstream_tpu.store.checkpoint import CheckpointedReader
+from hstream_tpu.store.streams import StreamType
+
+log = get_logger("tasks")
+
+SinkFn = Callable[[list[dict[str, Any]]], None]
+
+READ_CHUNK = 256
+POLL_TIMEOUT_MS = 50
+
+
+class QueryTask(threading.Thread):
+    """One continuous query: source stream(s) -> executor -> sink rows."""
+
+    def __init__(self, ctx, info: QueryInfo, plan, sink: SinkFn, *,
+                 from_beginning: bool = True):
+        super().__init__(name=f"query-{info.query_id}", daemon=True)
+        self.ctx = ctx
+        self.info = info
+        self.plan = plan
+        self.sink = sink
+        self.from_beginning = from_beginning
+        self.executor = None
+        self.error: BaseException | None = None
+        self._stop_ev = threading.Event()
+        self._sources: dict[int, str] = {}  # logid -> stream name
+        for name in self.source_streams():
+            self._sources[ctx.streams.get_logid(name)] = name
+        self._reader: CheckpointedReader | None = None
+
+    def source_streams(self) -> list[str]:
+        names = [self.plan.source]
+        if self.plan.join is not None:
+            names.append(self.plan.join.right.name)
+        return names
+
+    @property
+    def is_join(self) -> bool:
+        return self.plan.join is not None
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_ev.set()
+        if self.is_alive():
+            self.join(timeout)
+
+    def run(self) -> None:
+        ctx = self.ctx
+        try:
+            reader = CheckpointedReader(
+                f"query-{self.info.query_id}",
+                ctx.store.new_reader(max_logs=len(self._sources)),
+                ctx.ckp_store)
+            self._reader = reader
+            reader.set_timeout(POLL_TIMEOUT_MS)
+            for logid in self._sources:
+                reader.start_reading_from_checkpoint(logid, LSN_MIN)
+            ctx.persistence.set_query_status(self.info.query_id,
+                                             TaskStatus.RUNNING)
+            while not self._stop_ev.is_set():
+                results = reader.read(READ_CHUNK)
+                if not results:
+                    continue
+                ckps: dict[int, int] = {}
+                for r in results:
+                    if isinstance(r, DataBatch):
+                        self._process_batch(r)
+                    ckps[r.logid] = max(ckps.get(r.logid, 0),
+                                        r.lsn if isinstance(r, DataBatch)
+                                        else r.hi_lsn)
+                reader.write_checkpoints(ckps)
+            ctx.persistence.set_query_status(self.info.query_id,
+                                             TaskStatus.TERMINATED)
+        except BaseException as e:  # noqa: BLE001 — status must reflect death
+            self.error = e
+            log.error("query %s died: %s\n%s", self.info.query_id, e,
+                      traceback.format_exc())
+            try:
+                ctx.persistence.set_query_status(self.info.query_id,
+                                                 TaskStatus.CONNECTION_ABORT)
+            except Exception:
+                pass
+        finally:
+            ctx.running_queries.pop(self.info.query_id, None)
+
+    # ---- processing --------------------------------------------------------
+
+    def _process_batch(self, batch: DataBatch) -> None:
+        rows: list[dict[str, Any]] = []
+        ts: list[int] = []
+        for payload in batch.payloads:
+            r = rec.parse_record(payload)
+            d = rec.record_to_dict(r)
+            if d is None:
+                continue  # raw records skipped, like the reference's
+                # JSON-flag filter (HStore.hs:119-143)
+            rows.append(d)
+            ts.append(r.header.publish_time_ms or batch.append_time_ms)
+        if not rows:
+            return
+        if self.executor is None:
+            from hstream_tpu.sql.codegen import make_executor
+
+            self.executor = make_executor(self.plan, sample_rows=rows)
+        if self.is_join:
+            out = self.executor.process(rows, ts,
+                                        stream=self._sources[batch.logid])
+        else:
+            out = self.executor.process(rows, ts)
+        if out:
+            self.sink(out)
+
+
+def stream_sink(ctx, sink_stream: str,
+                stream_type: StreamType = StreamType.STREAM) -> SinkFn:
+    """Sink emitting rows as JSON records onto a stream (the reference's
+    internal sink processor, HStore.hs:152-163)."""
+    logid = ctx.streams.get_logid(sink_stream, stream_type)
+
+    def sink(rows: list[dict[str, Any]]) -> None:
+        payloads = [rec.build_record(row).SerializeToString()
+                    for row in rows]
+        ctx.store.append_batch(logid, payloads)
+
+    return sink

@@ -1,0 +1,121 @@
+"""Materialized views: registry, materialization store, pull queries.
+
+Reference: each grouped query registers its `Materialized` state in a
+global `groupbyStores` IORef (Handler/Common.hs:74-76); a pull query
+(`SELECT ... FROM view WHERE k = ...` without EMIT CHANGES) serializes
+the key, dumps the state store, filters by key, and for fixed windows
+groups rows by winStart with "winStart = .../winEnd = ..." labels
+(Handler.hs:277-325).
+
+Here a view's query task runs with emit_changes=False, so process()
+returns only CLOSED windows — those append to the materialization's
+bounded closed-row store — while the live (open-window) half is the
+executor's peek(). A pull query serves closed + live rows with the WHERE
+filter and projection applied host-side; winStart/winEnd ride along as
+structured fields (richer than the reference's string labels).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+from hstream_tpu.common.errors import ViewNotFound
+from hstream_tpu.engine.expr import eval_host
+from hstream_tpu.sql import ast
+
+
+class Materialization:
+    """Closed-window rows (bounded, newest kept) + live peek."""
+
+    def __init__(self, *, max_closed_rows: int = 100_000):
+        self._closed: OrderedDict[tuple, dict[str, Any]] = OrderedDict()
+        self._max = max_closed_rows
+        self._lock = threading.Lock()
+        self.task = None  # set by the owner; .executor gives live state
+
+    def _row_key(self, row: dict[str, Any]) -> tuple:
+        # (window, non-agg identity): last write per (winStart, key cols)
+        return (row.get("winStart"),
+                tuple(sorted((k, v) for k, v in row.items()
+                             if isinstance(v, str))))
+
+    def add_closed(self, rows: list[dict[str, Any]]) -> None:
+        with self._lock:
+            for row in rows:
+                key = self._row_key(row)
+                self._closed.pop(key, None)
+                self._closed[key] = row
+            while len(self._closed) > self._max:
+                self._closed.popitem(last=False)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        with self._lock:
+            rows = list(self._closed.values())
+        task = self.task
+        ex = getattr(task, "executor", None) if task is not None else None
+        if ex is not None and hasattr(ex, "peek"):
+            rows.extend(ex.peek())
+        return rows
+
+
+class ViewRegistry:
+    """view name -> Materialization (the groupbyStores analogue)."""
+
+    def __init__(self) -> None:
+        self._views: dict[str, Materialization] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, mat: Materialization) -> None:
+        with self._lock:
+            self._views[name] = mat
+
+    def get(self, name: str) -> Materialization:
+        with self._lock:
+            mat = self._views.get(name)
+        if mat is None:
+            raise ViewNotFound(name)
+        return mat
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._views.pop(name, None)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._views)
+
+
+def serve_select_view(mat: Materialization,
+                      select: ast.Select) -> list[dict[str, Any]]:
+    """Execute a pull query against a materialization
+    (reference Handler.hs:277-325: key filter + fixed-window slicing)."""
+    rows = mat.snapshot()
+    if select.where is not None:
+        kept = []
+        for row in rows:
+            try:
+                if eval_host(select.where, row):
+                    kept.append(row)
+            except (TypeError, KeyError):
+                continue
+        rows = kept
+    # fixed-window slicing: group/order by winStart (labels are fields)
+    rows.sort(key=lambda r: (r.get("winStart") or 0))
+    if select.items is None:
+        return rows
+    out = []
+    for row in rows:
+        proj: dict[str, Any] = {}
+        for idx, item in enumerate(select.items):
+            name = item.alias or item.text or f"col{idx}"
+            try:
+                proj[name] = eval_host(item.expr, row)
+            except (TypeError, KeyError):
+                proj[name] = None
+        for meta in ("winStart", "winEnd"):
+            if meta in row:
+                proj[meta] = row[meta]
+        out.append(proj)
+    return out

@@ -1,0 +1,151 @@
+"""Per-stream stats: counters + multi-level time-series rates.
+
+Reference: a C++ stats library with thread-local `PerStreamStats`
+(sharded counters aggregated on demand) and folly MultiLevelTimeSeries
+rates, where the metric registry is an X-macro `.inc` file so adding a
+metric is one line (common/clib/stats.h:80-118,
+common/include/per_stream_time_series.inc:24-40).
+
+Here the registry is the two lists below (same one-line property); the
+holder keeps per-thread counter shards aggregated on read — the GIL
+makes plain dict bumps atomic enough, but sharding keeps the write path
+contention-free and mirrors the reference's aggregation shape.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+
+# ---- metric registry (the .inc analogue: one line per metric) --------------
+
+PER_STREAM_COUNTERS = [
+    "append_payload_bytes",    # bytes appended (payload only)
+    "append_total",            # append batches
+    "append_failed",
+    "record_payload_bytes",    # bytes read out by consumers/queries
+    "record_total",            # records read
+]
+
+PER_STREAM_TIME_SERIES = [
+    # name, bucket seconds per level (reference: 1s/10s/60s multi-level)
+    ("append_in_bytes", (1, 10, 60)),
+    ("append_in_records", (1, 10, 60)),
+    ("record_bytes", (1, 10, 60)),
+]
+
+_TS_LEVELS = {name: levels for name, levels in PER_STREAM_TIME_SERIES}
+
+
+class TimeSeries:
+    """Sliding-window rate estimator: ring of 1s buckets, queried over
+    any of the registered level windows (MultiLevelTimeSeries shape)."""
+
+    def __init__(self, max_window_s: int = 60):
+        self._max = max_window_s
+        self._buckets: dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, value: float, now: float | None = None) -> None:
+        sec = int(now if now is not None else time.time())
+        with self._lock:
+            self._buckets[sec] = self._buckets.get(sec, 0.0) + value
+            if len(self._buckets) > self._max * 2:
+                cutoff = sec - self._max
+                for k in [k for k in self._buckets if k < cutoff]:
+                    del self._buckets[k]
+
+    def rate(self, window_s: int, now: float | None = None) -> float:
+        """Per-second rate over the trailing window."""
+        nowi = int(now if now is not None else time.time())
+        lo = nowi - window_s
+        with self._lock:
+            total = sum(v for s, v in self._buckets.items()
+                        if lo < s <= nowi)
+        return total / max(window_s, 1)
+
+
+class _Shard:
+    __slots__ = ("counters",)
+
+    def __init__(self) -> None:
+        self.counters: dict[tuple[str, str], int] = defaultdict(int)
+
+
+class StatsHolder:
+    """newStatsHolder analogue: per-thread counter shards + shared
+    time-series, aggregated on read (stats.h:80-118)."""
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+        self._shards: list[_Shard] = []
+        self._shards_lock = threading.Lock()
+        self._series: dict[tuple[str, str], TimeSeries] = {}
+        self._series_lock = threading.Lock()
+
+    def _shard(self) -> _Shard:
+        sh = getattr(self._local, "shard", None)
+        if sh is None:
+            sh = _Shard()
+            self._local.shard = sh
+            with self._shards_lock:
+                self._shards.append(sh)
+        return sh
+
+    # ---- counters ----
+    def stream_stat_add(self, metric: str, stream: str, value: int = 1
+                        ) -> None:
+        if metric not in PER_STREAM_COUNTERS:
+            raise KeyError(f"unregistered counter {metric!r}")
+        self._shard().counters[(metric, stream)] += value
+
+    def stream_stat_get(self, metric: str, stream: str) -> int:
+        with self._shards_lock:
+            shards = list(self._shards)
+        return sum(sh.counters.get((metric, stream), 0) for sh in shards)
+
+    def stream_stat_getall(self, metric: str) -> dict[str, int]:
+        with self._shards_lock:
+            shards = list(self._shards)
+        out: dict[str, int] = defaultdict(int)
+        for sh in shards:
+            for (m, stream), v in list(sh.counters.items()):
+                if m == metric:
+                    out[stream] += v
+        return dict(out)
+
+    # ---- time series ----
+    def _ts(self, metric: str, stream: str) -> TimeSeries:
+        if metric not in _TS_LEVELS:
+            raise KeyError(f"unregistered time series {metric!r}")
+        key = (metric, stream)
+        with self._series_lock:
+            ts = self._series.get(key)
+            if ts is None:
+                ts = TimeSeries(max(_TS_LEVELS[metric]))
+                self._series[key] = ts
+            return ts
+
+    def time_series_add(self, metric: str, stream: str, value: float
+                        ) -> None:
+        self._ts(metric, stream).add(value)
+
+    def time_series_get_rate(self, metric: str, stream: str,
+                             window_s: int | None = None) -> float:
+        levels = _TS_LEVELS[metric]
+        return self._ts(metric, stream).rate(window_s or levels[-1])
+
+    # ---- convenience for the append/read hot paths ----
+    def note_append(self, stream: str, n_records: int, n_bytes: int) -> None:
+        self.stream_stat_add("append_total", stream)
+        self.stream_stat_add("append_payload_bytes", stream, n_bytes)
+        ts = self._ts("append_in_bytes", stream)
+        ts.add(float(n_bytes))
+        self._ts("append_in_records", stream).add(float(n_records))
+
+    def note_read(self, stream: str, n_records: int, n_bytes: int) -> None:
+        self.stream_stat_add("record_total", stream, n_records)
+        self.stream_stat_add("record_payload_bytes", stream, n_bytes)
+        self._ts("record_bytes", stream).add(float(n_bytes))

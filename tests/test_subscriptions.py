@@ -1,0 +1,132 @@
+"""AckWindow property tests — the successor/range-merge logic the
+SURVEY flags as property-test-worthy (reference Common.hs:119-166)."""
+
+import random
+
+from hstream_tpu.server.subscriptions import AckWindow, RecId
+
+
+def deliver(win, batches):
+    for lsn, size in batches:
+        win.note_batch(lsn, size)
+
+
+def all_ids(batches):
+    return [RecId(lsn, i) for lsn, size in batches for i in range(size)]
+
+
+def test_in_order_acks_commit_everything():
+    win = AckWindow()
+    batches = [(1, 3), (2, 1), (3, 2)]
+    deliver(win, batches)
+    for rid in all_ids(batches):
+        win.ack(rid)
+    assert win.advance() == 3
+    assert win.ranges == []
+
+
+def test_out_of_order_acks_commit_only_prefix():
+    win = AckWindow()
+    deliver(win, [(1, 2), (2, 2)])
+    win.ack(RecId(2, 0))
+    win.ack(RecId(2, 1))
+    assert win.advance() is None          # lower bound still at (1,0)
+    win.ack(RecId(1, 1))
+    assert win.advance() is None          # (1,0) still missing
+    win.ack(RecId(1, 0))
+    assert win.advance() == 2             # everything acked
+
+
+def test_gap_counts_as_acked():
+    win = AckWindow()
+    win.note_batch(1, 1)
+    win.ack(RecId(1, 0))
+    win.note_gap(2, 5)                    # trim gap: auto-acked
+    win.note_batch(6, 1)
+    win.ack(RecId(6, 0))
+    assert win.advance() == 6
+
+
+def test_partial_batch_commits_previous_lsn():
+    win = AckWindow()
+    deliver(win, [(1, 1), (2, 3)])
+    win.ack(RecId(1, 0))
+    win.ack(RecId(2, 0))
+    win.ack(RecId(2, 1))
+    # batch 2 only partially acked -> ckp stops at lsn 1
+    assert win.advance() == 1
+
+
+def test_successor_across_unknown_lsn_defers():
+    win = AckWindow()
+    win.note_batch(1, 1)
+    win.ack(RecId(1, 0))
+    assert win.advance() == 1
+    # next batch arrives later with a dense successor lsn
+    win.note_batch(2, 2)
+    win.ack(RecId(2, 1))
+    assert win.advance() is None
+    win.ack(RecId(2, 0))
+    assert win.advance() == 2
+
+
+def test_property_random_ack_orders():
+    """Any ack permutation commits exactly the fully-acked prefix, and
+    after all acks the checkpoint covers the whole delivery."""
+    rng = random.Random(42)
+    for trial in range(50):
+        n_batches = rng.randint(1, 8)
+        batches = [(lsn, rng.randint(1, 4))
+                   for lsn, _ in enumerate(range(n_batches), start=1)]
+        win = AckWindow()
+        deliver(win, batches)
+        ids = all_ids(batches)
+        rng.shuffle(ids)
+        committed = 0
+        acked: set[RecId] = set()
+        for rid in ids:
+            win.ack(rid)
+            acked.add(rid)
+            got = win.advance()
+            if got is not None:
+                committed = got
+            # invariant: committed == largest lsn L such that every
+            # record of every batch <= L is acked
+            expect = 0
+            for lsn, size in batches:
+                if all(RecId(lsn, i) in acked for i in range(size)):
+                    expect = lsn
+                else:
+                    break
+            assert committed == expect, (trial, rid, committed, expect)
+        assert committed == batches[-1][0]
+        assert win.ranges == []
+
+
+def test_property_interleaved_delivery_and_acks():
+    """Delivery interleaved with acks (batches become known over time)."""
+    rng = random.Random(7)
+    for trial in range(30):
+        n_batches = rng.randint(2, 8)
+        batches = [(lsn, rng.randint(1, 3))
+                   for lsn in range(1, n_batches + 1)]
+        win = AckWindow()
+        committed = 0
+        acked: set[RecId] = set()
+        pending: list[RecId] = []
+        delivered = 0
+        while delivered < len(batches) or pending:
+            if delivered < len(batches) and (not pending or rng.random() < 0.5):
+                lsn, size = batches[delivered]
+                win.note_batch(lsn, size)
+                pending.extend(RecId(lsn, i) for i in range(size))
+                rng.shuffle(pending)
+                delivered += 1
+            else:
+                rid = pending.pop()
+                win.ack(rid)
+                acked.add(rid)
+                got = win.advance()
+                if got is not None:
+                    committed = got
+        assert committed == batches[-1][0], trial
