@@ -25,6 +25,7 @@ the device pipelining enqueued steps.
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Sequence
 
@@ -32,7 +33,7 @@ import jax
 import numpy as np
 
 from hstream_tpu.common.errors import SQLCodegenError
-from hstream_tpu.engine import lattice
+from hstream_tpu.engine import lattice, transport
 from hstream_tpu.engine.expr import (
     BinOp,
     Col,
@@ -64,6 +65,28 @@ def _align_down(ts: int, step: int) -> int:
 class _OpenWindow:
     start_abs: int  # absolute ms
     slot: int
+
+
+@dataclass
+class StagedBatch:
+    """A micro-batch encoded (and optionally uploaded) ahead of its step
+    dispatch — the unit of work between the ingest pipeline's encoder
+    thread and the executor's ordered step loop. Host copies are kept so
+    rare control-flow (gap split, rebase, epoch change) can fall back to
+    the synchronous path."""
+
+    n: int
+    cap: int
+    combo: Any
+    dt_base: int
+    words: Any                      # np.ndarray or device array
+    epoch: int
+    ts_min: int
+    ts_max: int
+    key_ids: np.ndarray
+    ts_ms: np.ndarray
+    cols: Mapping[str, np.ndarray]
+    nulls: Mapping[str, np.ndarray] | None
 
 
 class QueryExecutor:
@@ -127,6 +150,12 @@ class QueryExecutor:
         self.spec = lattice.LatticeSpec(
             n_keys=initial_keys, window=self.window, aggs=tuple(encoded_aggs))
         self.state = lattice.init_state(self.spec)
+        # sticky adaptive wire codec; survives recompiles (key growth).
+        # The lock serializes encode() between an IngestPipeline encoder
+        # thread and synchronous fallbacks on the caller thread.
+        self._transport = transport.BitpackTransport()
+        self._transport_lock = threading.Lock()
+        self._null_sticky: set[str] = set()  # null streams once seen
         self._compile()
 
         self.epoch: int | None = None        # absolute ms anchor, advance-aligned
@@ -141,6 +170,13 @@ class QueryExecutor:
         # call (populated by _track_windows, cleared per call)
         self._touched_this_call: set[int] = set()
         self.rebase_threshold = REBASE_THRESHOLD
+        # Deferred close decode: when True, closing a window dispatches
+        # extract+reset on device but keeps the packed result as a device
+        # value; drain_closed() decodes them later. This keeps the hot
+        # ingest loop free of forced device->host syncs (pull-based
+        # emission — the TPU analogue of the reference's sink append).
+        self.defer_close_decode = False
+        self._pending_closes: list[tuple[int, Any]] = []
 
     def _extract_filter(self) -> Expr | None:
         # Walk the child chain down to the source, ANDing every FilterNode
@@ -168,16 +204,44 @@ class QueryExecutor:
             for name in self._needed_cols)
         fns = lattice.compiled(self.spec, self.schema, self._filter_expr,
                                self.batch_capacity * n_per, self._layout)
-        self._step = fns.step
         self._extract_slot = fns.extract_slot
         self._reset_slot = fns.reset_slot
         self._extract_touched = fns.extract_touched
-        # per-agg null-ref columns in flag-bit order (non-None null keys)
-        self._null_refs = [
-            sorted(columns_of(agg.input))
+        # (null-flag stream name, referenced columns) per null-tracked agg
+        self._null_specs = [
+            (key, sorted(columns_of(agg.input)))
             for key, agg in zip(fns.null_keys, self.spec.aggs)
             if key is not None
         ]
+
+    def _run_step(self, cap: int, n: int, key_ids, ts_rel, cols,
+                  valid, null_streams, wm_rel) -> None:
+        """Encode one micro-batch with the v2 wire codec and dispatch the
+        jitted (decode+scatter) step. Null streams, once seen, stay on the
+        wire (sticky) so the encoding combo — and the compiled executable
+        — is stable batch-to-batch."""
+        combo, dt_base, words = self._encode_locked(
+            cap, n, key_ids, ts_rel, cols, valid, null_streams)
+        step = lattice.compiled_encoded_step(
+            self.spec, self.schema, self._filter_expr, combo, cap)
+        self.state = step(self.state, wm_rel, np.int32(n),
+                          np.int32(dt_base), words)
+
+    def _encode_locked(self, cap, n, key_ids, ts_rel, cols, valid,
+                       null_streams):
+        """Wire-encode under the transport lock (encoder thread vs sync
+        fallbacks). Null streams, once seen, stay on the wire (sticky) so
+        the encoding combo — and the compiled executable — is stable
+        batch-to-batch."""
+        with self._transport_lock:
+            for nk in null_streams:
+                self._null_sticky.add(nk)
+            for nk in self._null_sticky:
+                if nk not in null_streams:
+                    null_streams[nk] = np.zeros(n, dtype=np.bool_)
+            return self._transport.encode(
+                cap, n, key_ids, ts_rel, cols, self._layout,
+                valid=valid, null_streams=null_streams)
 
     # ---- keys --------------------------------------------------------------
 
@@ -374,22 +438,9 @@ class QueryExecutor:
 
         # SQL NULL handling: a NULL operand makes the WHERE predicate
         # not-true (row excluded) and excludes the row from that aggregate.
-        valid = batch.valid
-        if self._filter_expr is not None:
-            fm = np.zeros(cap, dtype=np.bool_)
-            for c in columns_of(self._filter_expr):
-                fm |= batch.nulls[c]
-            valid = valid & ~fm
-        null_masks = []
-        for refs in self._null_refs:
-            nm = np.zeros(cap, dtype=np.bool_)
-            for c in refs:
-                nm |= batch.nulls[c]
-            null_masks.append(nm)
-        packed = lattice.pack_batch_host(
-            cap, n, key_ids, ts_rel, valid, batch.cols, null_masks,
-            self._layout)
-        self.state = self._step(self.state, wm_rel, packed)
+        valid, null_streams = self._null_valid_streams(n, batch.nulls)
+        self._run_step(cap, n, key_ids, ts_rel, batch.cols, valid,
+                       null_streams, wm_rel)
 
         # host window bookkeeping
         out: list[dict[str, Any]] = []
@@ -478,18 +529,36 @@ class QueryExecutor:
         if int(ts_rel64.max()) >= (1 << 31):
             raise OverflowError(
                 "stream time span exceeds int32 relative range")
-        null_masks: list[np.ndarray | None] = []
-        for refs in self._null_refs:
-            if nulls is None:
-                null_masks.append(None)
-                continue
-            nm = np.zeros(n, dtype=np.bool_)
-            for c in refs:
-                if c in nulls:
-                    nm |= nulls[c][:n]
-            null_masks.append(nm)
         # SQL NULL in a WHERE operand makes the predicate not-true: fold
         # filter-column null masks into `valid` exactly like the row path.
+        valid, null_streams = self._null_valid_streams(n, nulls)
+        wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
+                          if self.watermark_abs >= 0 else -1)
+        self._run_step(cap, n, key_ids, ts_rel64, cols, valid,
+                       null_streams, wm_rel)
+
+        out: list[dict[str, Any]] = []
+        if self.window is not None:
+            self._track_windows(ts_list, batch_starts)
+        if max_ts > self.watermark_abs:
+            self.watermark_abs = max_ts
+        if self.emit_changes:
+            out.extend(self._drain_changes())
+        out.extend(self.close_due_windows())
+        return out
+
+    # ---- pipelined ingest (stage on one thread, step on another) ----------
+
+    def _null_valid_streams(self, n: int, nulls):
+        null_streams: dict[str, np.ndarray] = {}
+        if nulls is not None:
+            for nk, refs in self._null_specs:
+                nm = np.zeros(n, dtype=np.bool_)
+                for c in refs:
+                    if c in nulls:
+                        nm |= nulls[c][:n]
+                if nm.any():
+                    null_streams[nk] = nm
         valid = None
         if self._filter_expr is not None and nulls is not None:
             fm = np.zeros(n, dtype=np.bool_)
@@ -498,18 +567,94 @@ class QueryExecutor:
                     fm |= nulls[c][:n]
             if fm.any():
                 valid = ~fm
-        packed = lattice.pack_batch_host(
-            cap, n, key_ids, ts_rel64.astype(np.int32), valid, cols,
-            null_masks, self._layout)
+        return valid, null_streams
+
+    def stage_columnar(self, key_ids, ts_ms, cols, nulls=None,
+                       upload: bool = True) -> StagedBatch | None:
+        """Encode (and upload) one micro-batch ahead of its step — safe to
+        run on an encoder thread while the main thread dispatches earlier
+        batches, as long as stage calls happen in batch order (the wire
+        codec's adaptive state is ordered). Rare control flow (epoch
+        rebase, int32 overflow, gap splits) falls back to the synchronous
+        path inside process_staged()."""
+        key_ids = np.asarray(key_ids, dtype=np.int32)
+        n = len(key_ids)
+        if n == 0:
+            return None
+        if n > self.batch_capacity:
+            raise ValueError("stage_columnar: batch exceeds capacity; "
+                             "split upstream")
+        ts = np.asarray(ts_ms, dtype=np.int64)
+        self._ensure_epoch(int(ts.min()))
+        # single epoch read: a concurrent rebase on the caller thread
+        # between here and the stamp below must not split the two (the
+        # stamp is what process_staged validates against)
+        epoch = self.epoch
+        ts_rel64 = ts - epoch
+        staged = StagedBatch(
+            n=n, cap=round_up_pow2(n, lo=min(self.batch_capacity, 256)),
+            combo=None, dt_base=0, words=None, epoch=epoch,
+            ts_min=int(ts.min()), ts_max=int(ts.max()),
+            key_ids=key_ids, ts_ms=ts, cols=cols, nulls=nulls)
+        if int(ts_rel64.max()) >= (1 << 31):
+            return staged  # combo=None -> synchronous fallback (rebases)
+        valid, null_streams = self._null_valid_streams(n, nulls)
+        combo, dt_base, words = self._encode_locked(
+            staged.cap, n, key_ids, ts_rel64, cols, valid, null_streams)
+        staged.combo = combo
+        staged.dt_base = dt_base
+        staged.words = jax.device_put(words) if upload else words
+        return staged
+
+    def process_staged(self, staged: StagedBatch | None
+                       ) -> list[dict[str, Any]]:
+        """Ordered step dispatch for a staged batch (main thread)."""
+        if staged is None:
+            return []
+        if (staged.combo is None or staged.epoch != self.epoch
+                or staged.ts_max - self.epoch >= self.rebase_threshold):
+            # stale encode (epoch rebased since) or wide time span:
+            # synchronous path re-encodes with full handling
+            try:
+                return self._process_columnar(staged.key_ids, staged.ts_ms,
+                                              staged.cols, staged.nulls)
+            finally:
+                self._no_close.clear()
+                self._touched_this_call.clear()
+        try:
+            return self._process_staged(staged)
+        finally:
+            self._no_close.clear()
+            self._touched_this_call.clear()
+
+    def _process_staged(self, staged: StagedBatch) -> list[dict[str, Any]]:
+        ts_list = staged.ts_ms
+        batch_starts = None
+        if self.window is not None:
+            def sub(idx):
+                return self._process_columnar(
+                    staged.key_ids[idx], ts_list[idx],
+                    {k: np.asarray(v)[idx] for k, v in staged.cols.items()},
+                    None if staged.nulls is None else
+                    {k: np.asarray(v)[idx] for k, v in staged.nulls.items()})
+
+            guarded, batch_starts = self._gap_guard(ts_list, sub)
+            if guarded is not None:
+                return guarded
+
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
-        self.state = self._step(self.state, wm_rel, packed)
+        step = lattice.compiled_encoded_step(
+            self.spec, self.schema, self._filter_expr, staged.combo,
+            staged.cap)
+        self.state = step(self.state, wm_rel, np.int32(staged.n),
+                          np.int32(staged.dt_base), staged.words)
 
         out: list[dict[str, Any]] = []
         if self.window is not None:
             self._track_windows(ts_list, batch_starts)
-        if max_ts > self.watermark_abs:
-            self.watermark_abs = max_ts
+        if staged.ts_max > self.watermark_abs:
+            self.watermark_abs = staged.ts_max
         if self.emit_changes:
             out.extend(self._drain_changes())
         out.extend(self.close_due_windows())
@@ -577,9 +722,27 @@ class QueryExecutor:
     def _close_window(self, start: int) -> list[dict[str, Any]]:
         """Pop + extract (unless changelog mode) + reset one open window."""
         ow = self._open.pop(start)
-        rows = [] if self.emit_changes else self._extract_window_rows(ow)
+        if self.emit_changes:
+            rows = []
+        elif self.defer_close_decode:
+            # dispatch the extract, keep the device value; no host sync
+            self._pending_closes.append(
+                (ow.start_abs,
+                 self._extract_slot(self.state, np.int32(ow.slot))))
+            rows = []
+        else:
+            rows = self._extract_window_rows(ow)
         self.state = self._reset_slot(self.state, np.int32(ow.slot))
         self._no_close.discard(start)
+        return rows
+
+    def drain_closed(self) -> list[dict[str, Any]]:
+        """Decode every deferred window close (forces the device queue)."""
+        rows: list[dict[str, Any]] = []
+        for start_abs, packed_dev in self._pending_closes:
+            rows.extend(self._decode_extract(np.asarray(packed_dev),
+                                             start_abs))
+        self._pending_closes.clear()
         return rows
 
     def close_due_windows(self) -> list[dict[str, Any]]:
@@ -598,11 +761,15 @@ class QueryExecutor:
     def _extract_window_rows(self, ow: _OpenWindow) -> list[dict[str, Any]]:
         packed = np.asarray(self._extract_slot(self.state,
                                                np.int32(ow.slot)))
+        return self._decode_extract(packed, ow.start_abs)
+
+    def _decode_extract(self, packed: np.ndarray,
+                        start_abs: int | None) -> list[dict[str, Any]]:
         count, _start_rel, outs_np = lattice.unpack_extract_rows(
             self.spec, packed)
         rows = []
         for kid in np.nonzero(count > 0)[0]:
-            row = self._agg_row(int(kid), outs_np, int(kid), ow.start_abs)
+            row = self._agg_row(int(kid), outs_np, int(kid), start_abs)
             if row is not None:
                 rows.append(row)
         return rows

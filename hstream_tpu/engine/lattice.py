@@ -313,6 +313,29 @@ def build_step_packed(spec: LatticeSpec, agg_inputs: list[AggInput],
     return step
 
 
+def build_step_encoded(spec: LatticeSpec, agg_inputs: list[AggInput],
+                       filter_fn: ValueFn | None, combo, cap: int,
+                       null_keys) -> Callable:
+    """step(state, watermark, n, dt_base, words u32) -> state' over the
+    bit-packed v2 transport (engine.transport): the column decode is
+    traced into the same jit as the scatter, so XLA fuses unpack shifts
+    with the aggregation. Null-flag streams absent from the wire are
+    constant-folded to all-False."""
+    from hstream_tpu.engine import transport as tp
+
+    base = build_step_fn(spec, agg_inputs, filter_fn)
+
+    def step(state, watermark, n, dt_base, words):
+        key_ids, ts, valid, cols = tp.decode_batch(words, combo, cap, n,
+                                                   dt_base)
+        for nk in null_keys:
+            if nk is not None and nk not in cols:
+                cols[nk] = jnp.zeros((cap,), jnp.bool_)
+        return base(state, watermark, key_ids, ts, valid, cols)
+
+    return step
+
+
 def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
     """Finalize one slot column {plane: [K, ...]} -> {out_name: [K] f32}."""
     outs = {}
@@ -519,6 +542,25 @@ def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int,
         extract_touched=build_extract_touched(spec, max_out),
         null_keys=null_keys,
     )
+
+
+@functools.lru_cache(maxsize=2048)
+def compiled_encoded_step(spec: LatticeSpec, schema, filter_expr,
+                          combo, cap: int) -> Callable:
+    """Cached jit of the v2-transport step for one encoding combo. The
+    state argument is donated: steady-state ingest re-uses the lattice
+    buffers in place instead of allocating a fresh copy per micro-batch."""
+    from hstream_tpu.engine.expr import compile_device
+
+    agg_inputs, null_keys = compile_agg_inputs(spec, schema)
+    filter_fn = compile_device(filter_expr, schema) if filter_expr is not None \
+        else None
+    # donation is a TPU/GPU optimization; CPU (the test backend) ignores
+    # it with a warning per call, so only request it where it helps
+    donate = (0,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(build_step_encoded(spec, agg_inputs, filter_fn, combo,
+                                      cap, null_keys),
+                   donate_argnums=donate)
 
 
 @jax.jit

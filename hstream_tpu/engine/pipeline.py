@@ -1,0 +1,125 @@
+"""Double-buffered ingest pipeline: encode on a worker thread, step on
+the caller's thread, in strict batch order.
+
+The executor's hot loop has two host-side phases per micro-batch:
+  1. wire-encode (numpy bit-packing) + host->device upload
+  2. jitted step dispatch + window bookkeeping
+Phase 1 is pure w.r.t. engine state (the wire codec's adaptive state is
+owned by the encoder thread; batch order is preserved end-to-end), so it
+overlaps with phase 2 of earlier batches — upload of batch i+1 rides the
+link while batch i's scatter runs on the device. The reference has no
+analogue (its poll loop is strictly serial — Processor.hs:99-144); on
+TPU the overlap matters because the host->device link is the ingest
+bottleneck.
+
+Usage:
+    pipe = IngestPipeline(executor, depth=4)
+    emitted += pipe.submit(kids, ts_ms, cols)   # may return earlier
+    emitted += pipe.flush()                     # batches' emissions
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Mapping
+
+import numpy as np
+
+
+class IngestPipeline:
+    """Pipelines stage_columnar (worker thread) with process_staged
+    (caller thread) for one QueryExecutor. Not thread-safe itself: one
+    producer calls submit()/flush()."""
+
+    def __init__(self, executor, depth: int = 4):
+        self._ex = executor
+        self._in: queue.Queue = queue.Queue(maxsize=depth)
+        self._staged: queue.Queue = queue.Queue()
+        self._pending = 0          # batches submitted but not yet processed
+        self._dead = False         # worker exited (error or close())
+        self._err: BaseException | None = None
+        self._worker = threading.Thread(target=self._encode_loop,
+                                        daemon=True)
+        self._worker.start()
+
+    def _encode_loop(self) -> None:
+        while True:
+            item = self._in.get()
+            if item is None:
+                self._staged.put(None)
+                return
+            try:
+                kids, ts, cols, nulls = item
+                self._staged.put(self._ex.stage_columnar(kids, ts, cols,
+                                                         nulls))
+            except BaseException as e:  # surfaced on the caller thread
+                self._err = e
+                self._staged.put(None)
+                return
+
+    def _raise_worker_error(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def _process_one(self, block: bool) -> list[dict[str, Any]] | None:
+        """Process one staged batch if available; None when none ready."""
+        try:
+            staged = self._staged.get(block=block)
+        except queue.Empty:
+            return None
+        if staged is None:  # worker exit sentinel (error or close())
+            self._dead = True
+            self._raise_worker_error()
+            return []
+        self._pending -= 1
+        return self._ex.process_staged(staged)
+
+    def submit(self, key_ids: np.ndarray, ts_ms: np.ndarray,
+               cols: Mapping[str, np.ndarray],
+               nulls: Mapping[str, np.ndarray] | None = None,
+               ) -> list[dict[str, Any]]:
+        """Enqueue one micro-batch; processes any batches whose encode
+        already finished and returns their emitted rows (rows therefore
+        lag submission by the pipeline depth — call flush() for a
+        barrier)."""
+        self._raise_worker_error()
+        if self._dead:
+            raise RuntimeError("ingest pipeline worker has exited")
+        out: list[dict[str, Any]] = []
+        # backpressure: when the encoder is depth behind, block for one
+        block = self._in.full()
+        while True:
+            rows = self._process_one(block)
+            if rows is None:
+                break
+            out.extend(rows)
+            block = False
+        cap = self._ex.batch_capacity
+        for i in range(0, len(key_ids), cap):
+            sl = slice(i, i + cap)
+            self._in.put((np.asarray(key_ids)[sl],
+                          np.asarray(ts_ms)[sl],
+                          {k: np.asarray(v)[sl] for k, v in cols.items()},
+                          None if nulls is None else
+                          {k: np.asarray(v)[sl] for k, v in nulls.items()}))
+            self._pending += 1
+        return out
+
+    def flush(self) -> list[dict[str, Any]]:
+        """Barrier: wait until every submitted batch is staged and
+        processed; returns their emitted rows."""
+        out: list[dict[str, Any]] = []
+        while self._pending > 0:
+            if self._dead:
+                raise RuntimeError(
+                    "ingest pipeline worker died with batches pending")
+            rows = self._process_one(block=True)
+            if rows is not None:
+                out.extend(rows)
+        return out
+
+    def close(self) -> None:
+        self._in.put(None)
+        self._worker.join(timeout=5)

@@ -1,0 +1,243 @@
+"""Bit-packed columnar host->device transport (v2).
+
+The ingest wall on real deployments is the host->device link: every byte
+of a record batch crosses PCIe (or, on tunneled dev chips, a far slower
+link), so wire bytes per event — not host CPU and not device FLOPs — set
+the throughput ceiling. This module is the engine's answer: a
+Parquet-style adaptive columnar codec that encodes each micro-batch into
+ONE uint32 buffer, decoded on-device inside the jitted step (shifts and
+masks on the VPU, fused into the aggregation kernel by XLA).
+
+Per-stream encodings, chosen adaptively per column with sticky,
+monotone-widening policies so jit specializations stay bounded:
+
+  u8 / u16   unsigned bit-pack (4 / 2 values per word) — key ids,
+             timestamp deltas against a per-batch base, dictionary ids,
+             small ints
+  dec        int16 fixed-point for decimal-quantized floats (sensor
+             readings, prices): encodes round(v*scale) iff the exact
+             f32 round-trip  decode(encode(v)) == v  holds elementwise
+             (verified per batch, falls back to raw32 otherwise);
+             device decode is  i16 / scale  — IEEE division keeps the
+             round-trip bit-exact
+  bool8      bools / null bitmaps, one byte per value
+  raw32      f32 bitcast or i32, the lossless fallback
+
+The reference has no analogue (its ingest is per-record protobuf over a
+local socket — hstream-store cbits append path); this is TPU-first
+design: the wire format exists so the MXU/VPU never starves behind the
+link. Typical footprint: u16 key + u8 time delta + dec16 payload = 5
+bytes per event, vs 16 in the naive int32 transport — a 3.2x ingest
+ceiling raise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ENC_U8 = "u8"
+ENC_U16 = "u16"
+ENC_DEC = "dec"      # int16 fixed-point, scale in StreamPlan.scale
+ENC_BOOL8 = "bool8"
+ENC_RAW_F32 = "rawf"
+ENC_RAW_I32 = "rawi"
+
+_WORDS_PER_VALUE = {ENC_U8: 0.25, ENC_U16: 0.5, ENC_DEC: 0.5,
+                    ENC_BOOL8: 0.25, ENC_RAW_F32: 1.0, ENC_RAW_I32: 1.0}
+
+DEC_SCALES = (1, 10, 100)  # fixed-point scales tried for float columns
+DEC_LIMIT = 32767
+
+
+@dataclass(frozen=True)
+class StreamPlan:
+    """Encoding of one logical stream; part of the jit specialization key."""
+
+    name: str          # "__kid", "__dt", "__valid", or a column name
+    enc: str
+    scale: int = 0     # ENC_DEC only
+
+    def words(self, cap: int) -> int:
+        return int(cap * _WORDS_PER_VALUE[self.enc])
+
+
+Combo = tuple[StreamPlan, ...]
+
+
+def wire_bytes(combo: Combo, cap: int) -> int:
+    return 4 * sum(p.words(cap) for p in combo)
+
+
+def _pack_stream(plan: StreamPlan, vals: np.ndarray, cap: int) -> np.ndarray:
+    """Encode one stream (length n <= cap) into uint32 words."""
+    n = len(vals)
+    if plan.enc == ENC_U8:
+        buf = np.zeros(cap, np.uint8)
+        buf[:n] = vals
+        return buf.view(np.uint32)
+    if plan.enc == ENC_U16:
+        buf = np.zeros(cap, np.uint16)
+        buf[:n] = vals
+        return buf.view(np.uint32)
+    if plan.enc == ENC_DEC:
+        buf = np.zeros(cap, np.int16)
+        q = np.rint(np.asarray(vals, np.float64) * plan.scale)
+        buf[:n] = q.astype(np.int16)
+        return buf.view(np.uint32)
+    if plan.enc == ENC_BOOL8:
+        buf = np.zeros(cap, np.uint8)
+        buf[:n] = np.asarray(vals, np.bool_)
+        return buf.view(np.uint32)
+    if plan.enc == ENC_RAW_F32:
+        buf = np.zeros(cap, np.float32)
+        buf[:n] = vals
+        return buf.view(np.uint32)
+    buf = np.zeros(cap, np.int32)
+    buf[:n] = vals
+    return buf.view(np.uint32)
+
+
+def _unpack_stream(plan: StreamPlan, words: jnp.ndarray, cap: int):
+    """Traced device decode of one stream -> [cap] array."""
+    if plan.enc in (ENC_U8, ENC_BOOL8):
+        lanes = (words[:, None] >> jnp.uint32([0, 8, 16, 24])[None, :]
+                 ) & jnp.uint32(0xFF)
+        v = lanes.reshape(cap).astype(jnp.int32)
+        return v != 0 if plan.enc == ENC_BOOL8 else v
+    if plan.enc in (ENC_U16, ENC_DEC):
+        lanes = (words[:, None] >> jnp.uint32([0, 16])[None, :]
+                 ) & jnp.uint32(0xFFFF)
+        v = lanes.reshape(cap).astype(jnp.int32)
+        if plan.enc == ENC_U16:
+            return v
+        signed = v - ((v >> 15) << 16)  # sign-extend int16
+        # multiply by the f32 reciprocal — a single IEEE multiply is
+        # bit-identical between numpy (the encoder's verifier) and XLA,
+        # unlike division by a constant, which XLA strength-reduces
+        return signed.astype(jnp.float32) * jnp.float32(1.0 / plan.scale)
+    if plan.enc == ENC_RAW_F32:
+        return jax.lax.bitcast_convert_type(words, jnp.float32)
+    return jax.lax.bitcast_convert_type(words, jnp.int32)
+
+
+def decode_batch(words: jnp.ndarray, combo: Combo, cap: int, n, dt_base):
+    """Traced: ONE uint32 buffer -> (key_ids, ts_rel, valid, cols).
+
+    `n` and `dt_base` are device scalars (no recompile per batch). Rows
+    past n are masked invalid, so padding never reaches the lattice.
+    """
+    off = 0
+    streams: dict[str, jnp.ndarray] = {}
+    for plan in combo:
+        w = plan.words(cap)
+        streams[plan.name] = _unpack_stream(plan, words[off:off + w], cap)
+        off += w
+    key_ids = streams.pop("__kid")
+    ts = streams.pop("__dt") + dt_base
+    valid = jnp.arange(cap, dtype=jnp.int32) < n
+    if "__valid" in streams:
+        valid = valid & streams.pop("__valid")
+    return key_ids, ts, valid, streams
+
+
+class BitpackTransport:
+    """Per-query encoder with sticky adaptive per-column encoding.
+
+    Policies are monotone (u8 -> u16 -> raw32; dec -> raw32) so the set
+    of combos — and therefore jit recompiles — is bounded over a query's
+    lifetime.
+    """
+
+    def __init__(self) -> None:
+        self._dec_scale: dict[str, int] = {}   # col -> last good scale
+        self._demoted: set[str] = set()        # dec failed -> raw32 forever
+        self._uint_width: dict[str, str] = {}  # stream -> widest enc so far
+
+    def _widen_uint(self, name: str, vals: np.ndarray) -> str:
+        cur = self._uint_width.get(name, ENC_U8)
+        hi = int(vals.max()) if len(vals) else 0
+        lo = int(vals.min()) if len(vals) else 0
+        need = ENC_RAW_I32 if (lo < 0 or hi > 0xFFFF) else \
+            ENC_U16 if hi > 0xFF else ENC_U8
+        order = (ENC_U8, ENC_U16, ENC_RAW_I32)
+        enc = order[max(order.index(cur), order.index(need))]
+        self._uint_width[name] = enc
+        return enc
+
+    def _plan_float(self, name: str, vals: np.ndarray) -> StreamPlan:
+        if name in self._demoted:
+            return StreamPlan(name, ENC_RAW_F32)
+        scales = [self._dec_scale[name]] if name in self._dec_scale \
+            else list(DEC_SCALES)
+        v64 = np.asarray(vals, np.float64)
+        v32 = np.asarray(vals, np.float32)
+        for s in scales:
+            q = np.rint(v64 * s)
+            # NaN/inf fail the range check and demote to raw32; the
+            # round-trip check mirrors the device decode formula exactly
+            if (np.abs(q) <= DEC_LIMIT).all() and \
+                    (q.astype(np.float32) * np.float32(1.0 / s)
+                     == v32).all():
+                self._dec_scale[name] = s
+                return StreamPlan(name, ENC_DEC, s)
+        self._demoted.add(name)
+        self._dec_scale.pop(name, None)
+        return StreamPlan(name, ENC_RAW_F32)
+
+    def encode(self, cap: int, n: int, key_ids: np.ndarray,
+               ts_rel: np.ndarray,
+               cols: Mapping[str, np.ndarray],
+               layout: tuple[tuple[str, str], ...],
+               valid: np.ndarray | None = None,
+               null_streams: Mapping[str, np.ndarray] | None = None,
+               ) -> tuple[Combo, int, np.ndarray]:
+        """Encode one micro-batch -> (combo, dt_base, uint32 words).
+
+        `layout` is the (name, "f32"|"i32"|"bool") column layout from the
+        executor. `null_streams` maps __null_a{i} flag-stream names to
+        bool arrays (each becomes a bool8 stream; absent means no nulls).
+        """
+        plans: list[StreamPlan] = []
+        streams: list[np.ndarray] = []
+
+        plans.append(StreamPlan("__kid", self._widen_uint("__kid",
+                                                          key_ids[:n])))
+        streams.append(key_ids[:n])
+
+        dt_base = int(np.asarray(ts_rel[:n]).min()) if n else 0
+        dt = np.asarray(ts_rel[:n], np.int64) - dt_base
+        plans.append(StreamPlan("__dt", self._widen_uint("__dt", dt)))
+        streams.append(dt)
+
+        if valid is not None:
+            plans.append(StreamPlan("__valid", ENC_BOOL8))
+            streams.append(valid[:n])
+
+        for name, tag in layout:
+            vals = np.asarray(cols[name])[:n]
+            if tag == "f32":
+                plan = self._plan_float(name, vals)
+            elif tag == "bool":
+                plan = StreamPlan(name, ENC_BOOL8)
+            else:
+                plan = StreamPlan(name, self._widen_uint(name, vals))
+            plans.append(plan)
+            streams.append(vals)
+        for name, mask in (null_streams or {}).items():
+            plans.append(StreamPlan(name, ENC_BOOL8))
+            streams.append(mask[:n])
+
+        combo = tuple(plans)
+        total = sum(p.words(cap) for p in combo)
+        words = np.empty(total, np.uint32)
+        off = 0
+        for plan, vals in zip(combo, streams):
+            w = plan.words(cap)
+            words[off:off + w] = _pack_stream(plan, vals, cap)
+            off += w
+        return combo, dt_base, words

@@ -13,7 +13,7 @@ from typing import Any
 import numpy as np
 
 from hstream_tpu.engine import lattice as se_lattice
-from hstream_tpu.engine.executor import QueryExecutor
+from hstream_tpu.engine.executor import QueryExecutor, StagedBatch
 from hstream_tpu.engine.plan import AggregateNode
 from hstream_tpu.engine.types import Schema
 from hstream_tpu.parallel.lattice import ShardedLattice
@@ -54,8 +54,8 @@ class ShardedQueryExecutor(QueryExecutor):
         self._extract_slot = sharded.extract_slot
         self._reset_slot = sharded.reset_slot
         self._extract_touched = sharded.extract_touched
-        self._null_refs = [
-            sorted(columns_of(agg.input))
+        self._null_specs = [
+            (key, sorted(columns_of(agg.input)))
             for key, agg in zip(sharded.null_keys, self.spec.aggs)
             if key is not None
         ]
@@ -97,6 +97,34 @@ class ShardedQueryExecutor(QueryExecutor):
             k: jax.device_put(v, self._sharded.state_sharding(k))
             for k, v in grown.items()
         }
+
+    def stage_columnar(self, key_ids, ts_ms, cols, nulls=None,
+                       upload: bool = True) -> StagedBatch | None:
+        # Sharded execution keeps the v1 packed transport (the batch is
+        # distributed by shard_map, not the link codec), so staging
+        # degrades to a host-held batch; process_staged routes combo=None
+        # through the synchronous sharded path. IngestPipeline therefore
+        # still works, just without encode/step overlap.
+        key_ids = np.asarray(key_ids, dtype=np.int32)
+        if len(key_ids) == 0:
+            return None
+        ts = np.asarray(ts_ms, dtype=np.int64)
+        return StagedBatch(
+            n=len(key_ids), cap=0, combo=None, dt_base=0, words=None,
+            epoch=0, ts_min=int(ts.min()), ts_max=int(ts.max()),
+            key_ids=key_ids, ts_ms=ts, cols=cols, nulls=nulls)
+
+    def _run_step(self, cap, n, key_ids, ts_rel, cols, valid,
+                  null_streams, wm_rel) -> None:
+        # The sharded path keeps the v1 packed transport: the batch is
+        # split across the data axis by shard_map, so the wire format is
+        # the intra-host one (device_put with a sharding), not the
+        # bit-packed link codec.
+        null_masks = [null_streams.get(nk) for nk, _ in self._null_specs]
+        packed = se_lattice.pack_batch_host(
+            cap, n, key_ids, np.asarray(ts_rel).astype(np.int32), valid,
+            cols, null_masks, self._layout)
+        self.state = self._step(self.state, wm_rel, packed)
 
     def _drain_changes(self) -> list[dict[str, Any]]:
         self.state, touched = self._sharded.drain_touched(self.state)

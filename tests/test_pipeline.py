@@ -1,0 +1,176 @@
+"""IngestPipeline + staged-batch path: must match direct process_columnar
+results exactly, including the gap-guard fallback and deferred closes."""
+from __future__ import annotations
+
+import numpy as np
+
+from hstream_tpu.engine import (
+    AggKind,
+    AggSpec,
+    AggregateNode,
+    ColumnType,
+    QueryExecutor,
+    Schema,
+    SourceNode,
+    TumblingWindow,
+)
+from hstream_tpu.engine.expr import Col
+from hstream_tpu.engine.pipeline import IngestPipeline
+
+BASE = 1_700_000_000_000
+
+
+def make_ex(**kw):
+    schema = Schema.of(device=ColumnType.STRING, temp=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("sensors", schema),
+        group_keys=[Col("device")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "cnt"),
+              AggSpec(AggKind.SUM, "total", input=Col("temp"))],
+    )
+    ex = QueryExecutor(node, schema, emit_changes=False, initial_keys=256,
+                      batch_capacity=1024, **kw)
+    for k in range(8):
+        ex.key_id_for((f"d{k}",))
+    return ex
+
+
+def gen_batches(n_batches, batch=512, gap_at=None, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    t = BASE
+    for i in range(n_batches):
+        if gap_at is not None and i == gap_at:
+            t += 500_000_000  # huge stream-time jump -> gap guard
+        kids = rng.integers(0, 8, size=batch).astype(np.int32)
+        temps = (np.rint(rng.normal(20, 5, batch) * 10)
+                 .astype(np.float32) * np.float32(0.1))
+        ts = t + np.arange(batch, dtype=np.int64) * 4
+        t += batch * 4
+        out.append((kids, ts, {"temp": temps}))
+    return out
+
+
+def canon(rows):
+    return sorted((r["device"], r["winStart"], r["cnt"], round(r["total"], 2))
+                  for r in rows)
+
+
+def run_direct(batches):
+    ex = make_ex()
+    rows = []
+    for kids, ts, cols in batches:
+        rows.extend(ex.process_columnar(kids, ts, cols))
+    return ex, rows
+
+
+def run_pipelined(batches, **kw):
+    ex = make_ex()
+    for k, v in kw.items():
+        setattr(ex, k, v)
+    pipe = IngestPipeline(ex, depth=3)
+    rows = []
+    for kids, ts, cols in batches:
+        rows.extend(pipe.submit(kids, ts, cols))
+    rows.extend(pipe.flush())
+    pipe.close()
+    return ex, rows
+
+
+def test_pipeline_matches_direct():
+    batches = gen_batches(30)
+    _, direct = run_direct(batches)
+    _, piped = run_pipelined(batches)
+    assert len(direct) > 0
+    assert canon(direct) == canon(piped)
+
+
+def test_pipeline_gap_fallback_matches_direct():
+    batches = gen_batches(20, gap_at=10)
+    _, direct = run_direct(batches)
+    _, piped = run_pipelined(batches)
+    assert canon(direct) == canon(piped)
+
+
+def test_pipeline_deferred_close_decode():
+    batches = gen_batches(30)
+    _, direct = run_direct(batches)
+    ex, piped = run_pipelined(batches, defer_close_decode=True)
+    assert piped == []  # closes deferred, nothing decoded inline
+    deferred = ex.drain_closed()
+    assert canon(direct) == canon(deferred)
+
+
+def test_pipeline_epoch_rebase_fallback():
+    ex = make_ex()
+    ex.rebase_threshold = 1 << 22  # force rebases every ~4194s of stream
+    batches = gen_batches(12)
+    # stretch stream time so multiple rebases occur across the run
+    stretched = [(k, BASE + (t - BASE) * 900, c) for k, t, c in batches]
+    direct_rows = []
+    ex2 = make_ex()
+    ex2.rebase_threshold = 1 << 22
+    for kids, ts, cols in stretched:
+        direct_rows.extend(ex2.process_columnar(kids, ts, cols))
+    pipe = IngestPipeline(ex, depth=3)
+    rows = []
+    for kids, ts, cols in stretched:
+        rows.extend(pipe.submit(kids, ts, cols))
+    rows.extend(pipe.flush())
+    pipe.close()
+    assert canon(direct_rows) == canon(rows)
+
+
+def test_pipeline_worker_error_surfaces():
+    ex = make_ex()
+    pipe = IngestPipeline(ex, depth=2)
+    kids = np.zeros(4, np.int32)
+    ts = np.full(4, BASE, np.int64)
+    # missing column -> encoder thread raises; error must surface, and
+    # later calls must fail fast instead of hanging
+    pipe.submit(kids, ts, {})
+    import pytest as _pytest
+    with _pytest.raises((KeyError, RuntimeError)):
+        pipe.flush()
+    with _pytest.raises(RuntimeError):
+        pipe.flush()
+    with _pytest.raises(RuntimeError):
+        pipe.submit(kids, ts, {"temp": np.zeros(4, np.float32)})
+
+
+def test_sharded_executor_with_pipeline():
+    import jax
+    from hstream_tpu.parallel import ShardedQueryExecutor
+    from hstream_tpu.engine import (AggKind, AggSpec, AggregateNode,
+                                    ColumnType, Schema, SourceNode,
+                                    TumblingWindow)
+    from hstream_tpu.engine.expr import Col
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest as _pytest
+        _pytest.skip("needs multi-device mesh")
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(_np.array(devs[:2]).reshape(2, 1), ("data", "key"))
+    schema = Schema.of(device=ColumnType.STRING, temp=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("sensors", schema), group_keys=[Col("device")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "cnt"),
+              AggSpec(AggKind.SUM, "total", input=Col("temp"))])
+    ex = ShardedQueryExecutor(node, schema, mesh=mesh, emit_changes=False,
+                              initial_keys=256, batch_capacity=1024)
+    for k in range(8):
+        ex.key_id_for((f"d{k}",))
+    batches = gen_batches(12)
+    pipe = IngestPipeline(ex, depth=2)
+    rows = []
+    for kids, ts, cols in batches:
+        rows.extend(pipe.submit(kids, ts, cols))
+    rows.extend(pipe.flush())
+    pipe.close()
+    _, direct = run_direct(batches)
+    assert canon(direct) == canon(rows)

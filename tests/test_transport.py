@@ -1,0 +1,130 @@
+"""Tests for the bit-packed v2 wire codec (engine/transport.py)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hstream_tpu.engine import transport as tp
+
+
+def roundtrip(combo, dt_base, words, cap, n):
+    import jax
+
+    key_ids, ts, valid, cols = jax.jit(
+        lambda w: tp.decode_batch(w, combo, cap, np.int32(n),
+                                  np.int32(dt_base)),
+        static_argnums=())(words)
+    return (np.asarray(key_ids), np.asarray(ts), np.asarray(valid),
+            {k: np.asarray(v) for k, v in cols.items()})
+
+
+def test_u8_u16_roundtrip():
+    t = tp.BitpackTransport()
+    n, cap = 300, 512
+    kids = np.arange(n, dtype=np.int32) % 200          # fits u8
+    ts = np.arange(n, dtype=np.int64) * 3 + 1000       # span ~900 -> u16
+    cols = {"x": (np.arange(n, dtype=np.int32) * 7) % 50000}  # u16
+    combo, base, words = t.encode(cap, n, kids, ts, cols,
+                                  (("x", "i32"),))
+    k, ts2, valid, dcols = roundtrip(combo, base, words, cap, n)
+    assert valid[:n].all() and not valid[n:].any()
+    np.testing.assert_array_equal(k[:n], kids)
+    np.testing.assert_array_equal(ts2[:n], ts)
+    np.testing.assert_array_equal(dcols["x"][:n], cols["x"])
+
+
+def test_dec16_bitexact_roundtrip():
+    t = tp.BitpackTransport()
+    n = cap = 256
+    kids = np.zeros(n, np.int32)
+    ts = np.zeros(n, np.int64)
+    # decimal-quantized floats (1 decimal place, codec-canonical f32
+    # representation q * f32(0.1)), incl. negatives
+    raw = np.random.default_rng(0).normal(20, 5, n)
+    vals = (np.rint(raw * 10).astype(np.float32) * np.float32(0.1))
+    combo, base, words = t.encode(cap, n, kids, ts, {"temp": vals},
+                                  (("temp", "f32"),))
+    plan = [p for p in combo if p.name == "temp"][0]
+    assert plan.enc == tp.ENC_DEC and plan.scale == 10
+    _, _, _, dcols = roundtrip(combo, base, words, cap, n)
+    # bit-exact: the encoder verified decode(encode(v)) == v
+    np.testing.assert_array_equal(dcols["temp"][:n].view(np.int32),
+                                  vals.view(np.int32))
+
+
+def test_float_fallback_raw32():
+    t = tp.BitpackTransport()
+    n = cap = 256
+    vals = np.random.default_rng(1).normal(0, 1, n).astype(np.float32)
+    combo, base, words = t.encode(cap, n, np.zeros(n, np.int32),
+                                  np.zeros(n, np.int64), {"v": vals},
+                                  (("v", "f32"),))
+    plan = [p for p in combo if p.name == "v"][0]
+    assert plan.enc == tp.ENC_RAW_F32
+    _, _, _, dcols = roundtrip(combo, base, words, cap, n)
+    np.testing.assert_array_equal(dcols["v"][:n], vals)
+    # sticky: stays demoted even for a later decimal-friendly batch
+    ints = np.arange(n, dtype=np.float32)
+    combo2, _, _ = t.encode(cap, n, np.zeros(n, np.int32),
+                            np.zeros(n, np.int64), {"v": ints},
+                            (("v", "f32"),))
+    assert [p for p in combo2 if p.name == "v"][0].enc == tp.ENC_RAW_F32
+
+
+def test_monotone_widening():
+    t = tp.BitpackTransport()
+    n = cap = 256
+    small = np.arange(n, dtype=np.int32) % 100
+    big = np.arange(n, dtype=np.int32) * 300
+    args = (np.zeros(n, np.int64), {"x": small}, (("x", "i32"),))
+    c1, _, _ = t.encode(cap, n, small, *args)
+    assert [p for p in c1 if p.name == "x"][0].enc == tp.ENC_U8
+    c2, _, _ = t.encode(cap, n, small, np.zeros(n, np.int64), {"x": big},
+                        (("x", "i32"),))
+    assert [p for p in c2 if p.name == "x"][0].enc == tp.ENC_RAW_I32
+    # never narrows back
+    c3, _, _ = t.encode(cap, n, small, *args)
+    assert [p for p in c3 if p.name == "x"][0].enc == tp.ENC_RAW_I32
+
+
+def test_valid_and_null_streams():
+    t = tp.BitpackTransport()
+    n, cap = 100, 256
+    valid = np.ones(n, np.bool_)
+    valid[::3] = False
+    nullm = np.zeros(n, np.bool_)
+    nullm[5:10] = True
+    combo, base, words = t.encode(
+        cap, n, np.zeros(n, np.int32), np.zeros(n, np.int64),
+        {"x": np.ones(n, np.int32)}, (("x", "i32"),),
+        valid=valid, null_streams={"__null_a0": nullm})
+    _, _, v, cols = roundtrip(combo, base, words, cap, n)
+    np.testing.assert_array_equal(v[:n], valid)
+    assert not v[n:].any()
+    np.testing.assert_array_equal(cols["__null_a0"][:n], nullm)
+
+
+def test_bool_and_negative_ts_delta():
+    t = tp.BitpackTransport()
+    n = cap = 256
+    ts = 5000 - np.arange(n, dtype=np.int64)  # decreasing; base = min
+    flags = (np.arange(n) % 2 == 0)
+    combo, base, words = t.encode(cap, n, np.zeros(n, np.int32), ts,
+                                  {"b": flags}, (("b", "bool"),))
+    _, ts2, _, cols = roundtrip(combo, base, words, cap, n)
+    np.testing.assert_array_equal(ts2[:n], ts)
+    np.testing.assert_array_equal(cols["b"][:n], flags)
+
+
+def test_wire_bytes_headline_shape():
+    """The headline query's wire footprint: u16 kid + u8 dt + dec16 value
+    = 5 bytes/event (vs 16 for the naive int32 transport)."""
+    t = tp.BitpackTransport()
+    n = cap = 1024
+    kids = np.arange(n, dtype=np.int32) % 1000
+    ts = np.arange(n, dtype=np.int64) % 200
+    temps = (np.rint(np.random.default_rng(2).normal(20, 5, n) * 10)
+             .astype(np.float32) * np.float32(0.1))
+    combo, base, words = t.encode(cap, n, kids, ts, {"temp": temps},
+                                  (("temp", "f32"),))
+    assert tp.wire_bytes(combo, cap) == cap * 5

@@ -1,0 +1,51 @@
+"""Pin down: which shapes transfer slowly, and what actually forces
+execution through the tunnel."""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def t(fn, reps=3):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    N = 2 * 1024 * 1024  # 8MB of int32
+    shapes = [(N,), (4, N // 4), (N // 4, 4), (8, N // 8), (16, N // 16),
+              (128, N // 128), (N // 128, 128)]
+    for sh in shapes:
+        buf = np.zeros(sh, np.int32)
+        d = t(lambda b=buf: jax.device_put(b).block_until_ready())
+        print(f"put {sh!s:>18}: {d*1e3:7.1f} ms -> {buf.nbytes/d/1e6:8.1f} MB/s")
+
+    # does block_until_ready force execution? compare with explicit fetch
+    @jax.jit
+    def burn(x):
+        def body(i, acc):
+            return acc @ acc * 1e-3 + x
+        return jax.lax.fori_loop(0, 200, body, x)
+
+    x = jax.device_put(np.eye(4096, dtype=np.float32))
+    b1 = t(lambda: burn(x).block_until_ready())
+    print(f"burn + block_until_ready: {b1*1e3:.1f} ms")
+    b2 = t(lambda: np.asarray(burn(x)[0, 0]))
+    print(f"burn + fetch scalar slice: {b2*1e3:.1f} ms")
+
+    # fetch cost: tiny slice of a big resident array vs whole array
+    big = jax.device_put(np.zeros((1024, 2048), np.float32))
+    f1 = t(lambda: np.asarray(big[0, 0]), reps=5)
+    print(f"fetch scalar slice of resident: {f1*1e3:.1f} ms")
+    f2 = t(lambda: np.asarray(big), reps=5)
+    print(f"fetch whole 8MB resident: {f2*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
