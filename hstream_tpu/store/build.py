@@ -1,0 +1,33 @@
+"""Build the native store shared library (g++, no external deps beyond
+zlib). The .so is cached next to the source and rebuilt when the source
+is newer — a dev-friendly analogue of the reference's cbits build
+(hstream-store.cabal cxx-sources)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "cpp", "nstore.cpp")
+SO = os.path.join(_DIR, "cpp", "libnstore.so")
+_lock = threading.Lock()
+
+
+def build(force: bool = False) -> str:
+    """Compile cpp/nstore.cpp -> cpp/libnstore.so if stale; returns the
+    .so path."""
+    with _lock:
+        if (not force and os.path.exists(SO)
+                and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
+            return SO
+        tmp = SO + ".tmp"
+        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+               SRC, "-o", tmp, "-lz"]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native store build failed:\n{proc.stderr[-4000:]}")
+        os.replace(tmp, SO)
+        return SO

@@ -18,9 +18,18 @@ from hstream_tpu.store import (
 )
 
 
-@pytest.fixture
-def store():
-    return MemLogStore()
+@pytest.fixture(params=["mem", "native"])
+def store(request, tmp_path):
+    """Every store test runs against BOTH backends: the in-memory mock
+    and the durable C++ segment-log store."""
+    if request.param == "mem":
+        yield MemLogStore()
+    else:
+        from hstream_tpu.store.native import NativeLogStore
+
+        st = NativeLogStore(str(tmp_path / "nstore"))
+        yield st
+        st.close()
 
 
 def batches(results):
