@@ -401,22 +401,29 @@ class HStreamApiServicer:
 
         t = threading.Thread(target=drain_acks, daemon=True)
         t.start()
+        inflight = None  # batch taken from the queue but not yet yielded
         try:
             import queue as _q
 
             while context.is_active() and consumer.alive:
                 try:
-                    batch = consumer.queue.get(timeout=0.1)
+                    inflight = consumer.queue.get(timeout=0.1)
                 except _q.Empty:
                     continue
                 resp = pb.StreamingFetchResponse()
-                for rid, payload in batch:
+                for rid, payload in inflight:
                     resp.received_records.append(pb.ReceivedRecord(
                         record_id=pb.RecordId(batch_id=rid.lsn,
                                               batch_index=rid.idx),
                         record=payload))
                 yield resp
+                inflight = None
         finally:
+            # a batch obtained but not successfully yielded was noted in
+            # the AckWindow — hand it back for redelivery, else the ack
+            # lower bound stalls forever
+            if inflight is not None:
+                rt.requeue(inflight)
             rt.unregister_consumer(consumer)
 
     # ---- connectors ---------------------------------------------------------
@@ -629,7 +636,13 @@ class HStreamApiServicer:
         ctx = self.ctx
         select = plan.select if isinstance(plan, plans.CreateViewPlan) \
             else plan
-        mat = Materialization()
+        from hstream_tpu.engine.plan import AggregateNode
+        from hstream_tpu.sql.codegen import emitted_group_cols
+
+        group_cols = None
+        if isinstance(select.node, AggregateNode):
+            group_cols = emitted_group_cols(select.node)
+        mat = Materialization(group_cols=group_cols)
         task = QueryTask(ctx, info, select, mat.add_closed)
         mat.task = task
         ctx.views.register(info.sink, mat)

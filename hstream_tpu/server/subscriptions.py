@@ -161,6 +161,9 @@ class SubscriptionRuntime:
         self._rr = 0
         self._dispatcher: threading.Thread | None = None
         self._stop = threading.Event()
+        # batches reclaimed from dead consumers' queues, redelivered
+        # before anything newly fetched (at-least-once while running)
+        self._requeue: list[list[tuple[RecId, bytes]]] = []
 
     # ---- reader ------------------------------------------------------------
 
@@ -238,10 +241,32 @@ class SubscriptionRuntime:
         with self.lock:
             if c in self.consumers:
                 self.consumers.remove(c)
+            self._reclaim_locked(c)
+
+    def requeue(self, batch: list[tuple[RecId, bytes]]) -> None:
+        """Hand back a delivered-but-unconsumed batch for redelivery
+        (e.g. a StreamingFetch handler dying between queue.get and a
+        successful yield)."""
+        with self.lock:
+            self._requeue.append(batch)
+
+    def _reclaim_locked(self, c: Consumer) -> None:
+        """Reclaim undelivered batches from a dead consumer's queue for
+        redelivery. Caller holds self.lock."""
+        while True:
+            try:
+                self._requeue.append(c.queue.get_nowait())
+            except queue.Empty:
+                break
 
     def _dispatch_loop(self) -> None:
         # 10ms low-res poll like the reference's readAndDispatchRecords
-        # timer (Handler.hs:819-922), round-robining batches to consumers
+        # timer (Handler.hs:819-922), round-robining batches to consumers.
+        # A fetched batch is already noted in the AckWindow, so it must
+        # never be dropped: a batch that finds no queue slot is re-offered
+        # (rotating consumers) until someone takes it — only then do we
+        # fetch more. Otherwise the ack lower bound would stall forever.
+        pending: list[tuple[RecId, bytes]] | None = None
         while not self._stop.is_set():
             with self.lock:
                 alive = [c for c in self.consumers if c.alive]
@@ -249,19 +274,32 @@ class SubscriptionRuntime:
                 if self._stop.wait(0.05):
                     return
                 continue
-            batch = self.fetch(timeout_ms=10, max_size=64)
-            if not batch:
-                continue
+            if pending is None:
+                with self.lock:
+                    if self._requeue:
+                        pending = self._requeue.pop(0)
+            if pending is None:
+                batch = self.fetch(timeout_ms=10, max_size=64)
+                if not batch:
+                    continue
+                pending = batch
             with self.lock:
                 alive = [c for c in self.consumers if c.alive]
                 if not alive:
-                    continue
+                    continue  # keep pending until a consumer returns
                 c = alive[self._rr % len(alive)]
                 self._rr += 1
             try:
-                c.queue.put(batch, timeout=5)
+                c.queue.put(pending, timeout=0.2)
             except queue.Full:
-                pass  # slow consumer: drop from queue (redelivery via ckp)
+                continue  # slow consumer: re-offer to the next one
+            pending = None
+            with self.lock:
+                if not c.alive:
+                    # consumer died around the put: unregister's drain may
+                    # have run before the put landed — reclaim anything
+                    # stranded in the abandoned queue (at-least-once)
+                    self._reclaim_locked(c)
 
     def shutdown(self) -> None:
         self._stop.set()

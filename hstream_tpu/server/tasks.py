@@ -46,6 +46,9 @@ class QueryTask(threading.Thread):
         self.from_beginning = from_beginning
         self.executor = None
         self.error: BaseException | None = None
+        # serializes executor state mutation (this thread) against pull
+        # queries peeking live state from gRPC threads (views.snapshot)
+        self.state_lock = threading.RLock()
         self._stop_ev = threading.Event()
         self._sources: dict[int, str] = {}  # logid -> stream name
         for name in self.source_streams():
@@ -123,17 +126,23 @@ class QueryTask(threading.Thread):
             ts.append(r.header.publish_time_ms or batch.append_time_ms)
         if not rows:
             return
-        if self.executor is None:
-            from hstream_tpu.sql.codegen import make_executor
+        with self.state_lock:
+            if self.executor is None:
+                from hstream_tpu.sql.codegen import make_executor
 
-            self.executor = make_executor(self.plan, sample_rows=rows)
-        if self.is_join:
-            out = self.executor.process(rows, ts,
-                                        stream=self._sources[batch.logid])
-        else:
-            out = self.executor.process(rows, ts)
-        if out:
-            self.sink(out)
+                self.executor = make_executor(self.plan, sample_rows=rows)
+            if self.is_join:
+                out = self.executor.process(
+                    rows, ts, stream=self._sources[batch.logid])
+            else:
+                out = self.executor.process(rows, ts)
+            # sink under the lock: a window removed from live state must
+            # appear in the sink (view closed rows) atomically with the
+            # removal, or a concurrent pull-query snapshot sees it in
+            # neither half (no lock-order cycle: views.snapshot releases
+            # the materialization lock before taking state_lock)
+            if out:
+                self.sink(out)
 
 
 def stream_sink(ctx, sink_stream: str,

@@ -27,19 +27,30 @@ from hstream_tpu.sql import ast
 
 
 class Materialization:
-    """Closed-window rows (bounded, newest kept) + live peek."""
+    """Closed-window rows (bounded, newest kept) + live peek.
 
-    def __init__(self, *, max_closed_rows: int = 100_000):
+    `group_cols` are the plan's actual GROUP BY columns: closed rows are
+    keyed on (winStart, group values) so distinct keys of ANY type —
+    numeric included — stay distinct. A view over a stateless select has
+    no group identity; every row is kept under a sequence key.
+    """
+
+    def __init__(self, *, group_cols: list[str] | None = None,
+                 max_closed_rows: int = 100_000):
+        self._group_cols = group_cols
         self._closed: OrderedDict[tuple, dict[str, Any]] = OrderedDict()
         self._max = max_closed_rows
+        self._seq = 0
         self._lock = threading.Lock()
         self.task = None  # set by the owner; .executor gives live state
 
     def _row_key(self, row: dict[str, Any]) -> tuple:
-        # (window, non-agg identity): last write per (winStart, key cols)
+        # (window, group identity): last write per (winStart, key cols)
+        if self._group_cols is None:
+            self._seq += 1
+            return ("#seq", self._seq)
         return (row.get("winStart"),
-                tuple(sorted((k, v) for k, v in row.items()
-                             if isinstance(v, str))))
+                tuple(row.get(c) for c in self._group_cols))
 
     def add_closed(self, rows: list[dict[str, Any]]) -> None:
         with self._lock:
@@ -51,12 +62,20 @@ class Materialization:
                 self._closed.popitem(last=False)
 
     def snapshot(self) -> list[dict[str, Any]]:
-        with self._lock:
-            rows = list(self._closed.values())
         task = self.task
-        ex = getattr(task, "executor", None) if task is not None else None
-        if ex is not None and hasattr(ex, "peek"):
-            rows.extend(ex.peek())
+        if task is None:
+            with self._lock:
+                return list(self._closed.values())
+        # state_lock around BOTH halves (closed copy + live peek), in the
+        # same order the task thread takes them (state_lock -> mat._lock
+        # via sink): a window closing between the two reads would
+        # otherwise appear in neither half
+        with task.state_lock:
+            with self._lock:
+                rows = list(self._closed.values())
+            ex = task.executor
+            if ex is not None and hasattr(ex, "peek"):
+                rows.extend(ex.peek())
         return rows
 
 

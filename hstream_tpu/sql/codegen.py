@@ -382,6 +382,27 @@ def explain_text(plan: plans.Plan) -> str:
     return type(plan).__name__
 
 
+def emitted_group_cols(node: AggregateNode) -> list[str]:
+    """Names under which the group-key columns appear in EMITTED rows.
+
+    Without post projections rows carry the plan column names; with them
+    (any aliased/computed select item) a key column emits under the name
+    of the first projected item that is exactly that column — e.g.
+    `SELECT city AS c ... GROUP BY city` emits the key as "c". Consumers
+    keying on emitted rows (materialized views) must use these names."""
+    out = []
+    for g in node.group_keys:
+        if not isinstance(g, Col):
+            continue
+        name = g.name
+        for out_name, e in (node.post_projections or []):
+            if isinstance(e, Col) and e.name == g.name:
+                name = out_name
+                break
+        out.append(name)
+    return out
+
+
 def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
                   mesh=None, initial_keys: int = 1024,
                   batch_capacity: int = 4096):
