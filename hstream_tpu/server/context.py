@@ -52,7 +52,9 @@ class ServerContext:
     def shutdown(self) -> None:
         for task in list(self.running_queries.values()):
             try:
-                task.stop()
+                # detach: snapshot state but leave status RUNNING so the
+                # next boot's resume_persisted relaunches the query
+                task.stop(detach=True)
             except Exception:
                 pass
         for task in list(self.running_connectors.values()):

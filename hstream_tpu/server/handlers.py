@@ -40,7 +40,7 @@ from hstream_tpu.server.persistence import (
     now_ms,
 )
 from hstream_tpu.server.subscriptions import RecId
-from hstream_tpu.server.tasks import QueryTask, stream_sink
+from hstream_tpu.server.tasks import QueryTask, snapshot_key, stream_sink
 from hstream_tpu.server.views import Materialization, serve_select_view
 from hstream_tpu.sql import plans
 from hstream_tpu.sql.codegen import explain_text, stream_codegen
@@ -278,7 +278,7 @@ class HStreamApiServicer:
         info = self.ctx.persistence.get_query(request.id)
         self._terminate_query(request.id)
         self.ctx.persistence.remove_query(request.id)
-        self.ctx.ckp_store.remove(f"query-{request.id}")
+        self._remove_query_state(request.id)
         if info.query_type == QUERY_PUSH and info.sink:
             try:
                 self.ctx.streams.delete_stream(info.sink, StreamType.TEMP)
@@ -290,11 +290,17 @@ class HStreamApiServicer:
     def RestartQuery(self, request, context):
         """The reference leaves this unimplemented
         (Handler/Query.hs:152-160); here a terminated query resumes from
-        its read checkpoints."""
+        its snapshotted operator state + paired read checkpoints."""
         ctx = self.ctx
         info = ctx.persistence.get_query(request.id)
         if request.id in ctx.running_queries:
             raise ServerError(f"query {request.id} is already running")
+        self._resume_query(info)
+        ctx.persistence.set_query_status(info.query_id, TaskStatus.RUNNING)
+        return empty_pb2.Empty()
+
+    def _resume_query(self, info: QueryInfo) -> None:
+        ctx = self.ctx
         plan = stream_codegen(info.sql)
         if info.query_type == QUERY_VIEW:
             self._start_view_task(info, plan)
@@ -307,8 +313,28 @@ class HStreamApiServicer:
                              else plan.select, sink)
             ctx.running_queries[info.query_id] = task
             task.start()
-        ctx.persistence.set_query_status(info.query_id, TaskStatus.RUNNING)
-        return empty_pb2.Empty()
+
+    def resume_persisted(self) -> None:
+        """Boot-time resume: relaunch every query that was RUNNING when
+        the server last stopped (the reference resumes query definitions
+        from ZK metadata, Persistence.hs:197-256; here operator state
+        resumes too via the snapshot blobs)."""
+        ctx = self.ctx
+        for info in ctx.persistence.get_queries():
+            if info.status not in (TaskStatus.RUNNING, TaskStatus.CREATED):
+                continue
+            if info.query_id in ctx.running_queries:
+                continue
+            try:
+                self._resume_query(info)
+            except Exception:  # noqa: BLE001 — one bad query must not
+                # block boot; its status records the failure
+                log.exception("resume of query %s failed", info.query_id)
+                try:
+                    ctx.persistence.set_query_status(
+                        info.query_id, TaskStatus.CONNECTION_ABORT)
+                except Exception:
+                    pass
 
     # ---- subscriptions ------------------------------------------------------
 
@@ -613,6 +639,12 @@ class HStreamApiServicer:
         task.start()
         return info
 
+    def _remove_query_state(self, query_id: str) -> None:
+        """Durable per-query state cleanup: operator-state snapshot +
+        read checkpoints."""
+        self.ctx.store.meta_delete(snapshot_key(query_id))
+        self.ctx.ckp_store.remove(f"query-{query_id}")
+
     def _terminate_query(self, query_id: str) -> None:
         ctx = self.ctx
         ctx.persistence.get_query(query_id)  # raises if unknown
@@ -644,6 +676,8 @@ class HStreamApiServicer:
             group_cols = emitted_group_cols(select.node)
         mat = Materialization(group_cols=group_cols)
         task = QueryTask(ctx, info, select, mat.add_closed)
+        task.sink_dump = mat.dump
+        task.sink_load = mat.load
         mat.task = task
         ctx.views.register(info.sink, mat)
         ctx.running_queries[info.query_id] = task
@@ -661,6 +695,7 @@ class HStreamApiServicer:
             ctx.persistence.remove_query(query_id)
         except QueryNotFound:
             pass
+        self._remove_query_state(query_id)
 
     def _create_connector(self, cid: str, sql: str,
                           plan: plans.CreateSinkConnectorPlan

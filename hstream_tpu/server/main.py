@@ -31,11 +31,15 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                  ("grpc.max_send_message_length", 64 * 1024 * 1024)])
-    add_hstream_api_to_server(HStreamApiServicer(ctx), server)
+    servicer = HStreamApiServicer(ctx)
+    add_hstream_api_to_server(servicer, server)
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise RuntimeError(f"cannot bind {host}:{port}")
     ctx.port = bound
+    # only after a successful bind: a failed boot (port in use) must not
+    # relaunch tasks and re-emit at-least-once rows before dying
+    servicer.resume_persisted()
     server.start()
     log.info("hstream-tpu server listening on %s:%d (store %s)",
              host, bound, store_uri)

@@ -8,18 +8,31 @@ processor DAG, and sink processors append results downstream
 Here a task is one daemon thread per query driving the batched engine:
 read a chunk from the checkpointed reader -> decode JSON records ->
 executor.process (the jitted lattice step) -> emit rows to the sink
-callback -> commit read checkpoints. Joins read both streams through the
-same reader and route batches by origin stream.
+callback -> checkpoint.
+
+Checkpointing improves on the reference (which checkpoints readers only
+— operator state is in-memory, so its restarts undercount every window
+spanning them, Codegen.hs:374-385): read positions are committed ONLY
+paired with an operator-state snapshot, in one atomic meta-KV write
+(engine.snapshot). Resume restores the state and continues from the
+paired LSNs — exact, modulo at-least-once re-emission of rows sunk
+after the last snapshot.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Any, Callable
 
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.logger import get_logger
+from hstream_tpu.engine.snapshot import (
+    capture_executor,
+    restore_executor,
+    serialize_capture,
+)
 from hstream_tpu.server.persistence import QueryInfo, TaskStatus
 from hstream_tpu.store.api import LSN_MIN, DataBatch
 from hstream_tpu.store.checkpoint import CheckpointedReader
@@ -33,8 +46,16 @@ READ_CHUNK = 256
 POLL_TIMEOUT_MS = 50
 
 
+def snapshot_key(query_id: str) -> str:
+    """Meta-KV key holding a query's operator-state snapshot."""
+    return f"qsnap/{query_id}"
+
+
 class QueryTask(threading.Thread):
     """One continuous query: source stream(s) -> executor -> sink rows."""
+
+    # state snapshot + checkpoint cadence; tests lower it
+    snapshot_interval_ms: int = 1000
 
     def __init__(self, ctx, info: QueryInfo, plan, sink: SinkFn, *,
                  from_beginning: bool = True):
@@ -49,11 +70,20 @@ class QueryTask(threading.Thread):
         # serializes executor state mutation (this thread) against pull
         # queries peeking live state from gRPC threads (views.snapshot)
         self.state_lock = threading.RLock()
+        # optional sink-side state riding in the snapshot (a view's
+        # closed-row materialization survives restarts this way)
+        self.sink_dump: Callable[[], Any] | None = None
+        self.sink_load: Callable[[Any], None] | None = None
         self._stop_ev = threading.Event()
         self._sources: dict[int, str] = {}  # logid -> stream name
         for name in self.source_streams():
             self._sources[ctx.streams.get_logid(name)] = name
         self._reader: CheckpointedReader | None = None
+        self._pending_ckps: dict[int, int] = {}  # processed, not committed
+        self._last_snapshot_ms = 0.0
+        self._dirty = False
+        self._crash = False
+        self._detach = False
 
     def source_streams(self) -> list[str]:
         names = [self.plan.source]
@@ -67,7 +97,19 @@ class QueryTask(threading.Thread):
 
     # ---- lifecycle ---------------------------------------------------------
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def stop(self, timeout: float = 10.0, *, crash: bool = False,
+             detach: bool = False) -> None:
+        """Stop modes:
+        default — user-initiated terminate: final snapshot + TERMINATED.
+        detach=True — server shutdown: final snapshot but status stays
+        RUNNING so boot-time resume_persisted relaunches the query.
+        crash=True — fault injection (tests): no snapshot, no status
+        update, like a killed process; resume replays from the last
+        periodic snapshot."""
+        if crash:
+            self._crash = True
+        if detach:
+            self._detach = True
         self._stop_ev.set()
         if self.is_alive():
             self.join(timeout)
@@ -81,24 +123,34 @@ class QueryTask(threading.Thread):
                 ctx.ckp_store)
             self._reader = reader
             reader.set_timeout(POLL_TIMEOUT_MS)
+            resumed = self._restore_state()
             for logid in self._sources:
-                reader.start_reading_from_checkpoint(logid, LSN_MIN)
+                if resumed is not None and logid in resumed:
+                    reader.start_reading(logid, resumed[logid] + 1)
+                else:
+                    reader.start_reading_from_checkpoint(logid, LSN_MIN)
             ctx.persistence.set_query_status(self.info.query_id,
                                              TaskStatus.RUNNING)
             while not self._stop_ev.is_set():
                 results = reader.read(READ_CHUNK)
                 if not results:
+                    self._maybe_snapshot()
                     continue
-                ckps: dict[int, int] = {}
                 for r in results:
                     if isinstance(r, DataBatch):
                         self._process_batch(r)
-                    ckps[r.logid] = max(ckps.get(r.logid, 0),
-                                        r.lsn if isinstance(r, DataBatch)
-                                        else r.hi_lsn)
-                reader.write_checkpoints(ckps)
-            ctx.persistence.set_query_status(self.info.query_id,
-                                             TaskStatus.TERMINATED)
+                    lsn = (r.lsn if isinstance(r, DataBatch) else r.hi_lsn)
+                    if lsn > self._pending_ckps.get(r.logid, 0):
+                        self._pending_ckps[r.logid] = lsn
+                        self._dirty = True
+                self._maybe_snapshot()
+            if not self._crash:
+                self._snapshot_now()  # graceful stop: state is durable
+                if not self._detach:
+                    ctx.persistence.set_query_status(
+                        self.info.query_id, TaskStatus.TERMINATED)
+            # detach (server shutdown) and crash both leave status
+            # RUNNING so boot-time resume_persisted relaunches the query
         except BaseException as e:  # noqa: BLE001 — status must reflect death
             self.error = e
             log.error("query %s died: %s\n%s", self.info.query_id, e,
@@ -110,6 +162,63 @@ class QueryTask(threading.Thread):
                 pass
         finally:
             ctx.running_queries.pop(self.info.query_id, None)
+
+    # ---- operator-state checkpointing --------------------------------------
+
+    def _restore_state(self) -> dict[int, int] | None:
+        """Restore executor + sink state from the last snapshot. Returns
+        the read positions the state corresponds to (logid -> committed
+        LSN), or None when starting fresh."""
+        blob = self.ctx.store.meta_get(snapshot_key(self.info.query_id))
+        if blob is None:
+            return None
+        with self.state_lock:
+            self.executor, extra = restore_executor(self.plan, blob)
+            if self.sink_load is not None and "sink" in extra:
+                self.sink_load(extra["sink"])
+        ckps = {int(k): int(v) for k, v in extra.get("ckps", {}).items()}
+        self._pending_ckps = dict(ckps)
+        self._last_snapshot_ms = time.monotonic() * 1000
+        log.info("query %s resumed from snapshot at %s",
+                 self.info.query_id, ckps)
+        return ckps
+
+    def _maybe_snapshot(self) -> None:
+        if not self._dirty:
+            return
+        now = time.monotonic() * 1000
+        if now - self._last_snapshot_ms >= self.snapshot_interval_ms:
+            self._snapshot_now()
+
+    def _snapshot_now(self) -> None:
+        """Atomically persist (operator state, read checkpoints): one
+        meta-KV write. Read positions NEVER advance past durable state —
+        the reference's failure mode (commit-then-lose-state undercount)
+        cannot happen. The ckp store mirrors the LSNs for observability."""
+        if not self._dirty:
+            return
+        extra: dict[str, Any] = {
+            "ckps": {str(k): v for k, v in self._pending_ckps.items()}}
+        if self.executor is None:
+            # nothing aggregated yet (e.g. raw records only): committing
+            # the read position loses no state
+            if self._reader is not None and self._pending_ckps:
+                self._reader.write_checkpoints(self._pending_ckps)
+            self._last_snapshot_ms = time.monotonic() * 1000
+            self._dirty = False
+            return
+        # capture under the lock (cheap, consistent), serialize outside
+        # (device sync + npz pack must not stall ingest or pull queries)
+        with self.state_lock:
+            if self.sink_dump is not None:
+                extra["sink"] = self.sink_dump()
+            meta, arrays = capture_executor(self.executor, extra)
+        blob = serialize_capture(meta, arrays)
+        self.ctx.store.meta_put(snapshot_key(self.info.query_id), blob)
+        if self._reader is not None and self._pending_ckps:
+            self._reader.write_checkpoints(self._pending_ckps)
+        self._last_snapshot_ms = time.monotonic() * 1000
+        self._dirty = False
 
     # ---- processing --------------------------------------------------------
 

@@ -61,6 +61,15 @@ class Materialization:
             while len(self._closed) > self._max:
                 self._closed.popitem(last=False)
 
+    def dump(self) -> list[dict[str, Any]]:
+        """Closed rows in insertion order — rides in the query task's
+        operator-state snapshot so the view survives restarts."""
+        with self._lock:
+            return list(self._closed.values())
+
+    def load(self, rows: list[dict[str, Any]]) -> None:
+        self.add_closed(rows)
+
     def snapshot(self) -> list[dict[str, Any]]:
         task = self.task
         if task is None:
