@@ -129,14 +129,12 @@ def kernel_only_eps(ex, src) -> float:
                                          staged.cap)
     wm = np.int32(0)
     st = ex.state
-    st = step(st, wm, np.int32(staged.n), np.int32(staged.dt_base),
-              staged.words)
+    st = step(st, wm, np.int32(staged.n), staged.bases, staged.words)
     np.asarray(st["count"][0, 0])
     reps = 10
     t0 = time.perf_counter()
     for _ in range(reps):
-        st = step(st, wm, np.int32(staged.n), np.int32(staged.dt_base),
-                  staged.words)
+        st = step(st, wm, np.int32(staged.n), staged.bases, staged.words)
     np.asarray(st["count"][0, 0])
     dt = time.perf_counter() - t0
     ex.state = st

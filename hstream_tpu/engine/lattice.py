@@ -316,18 +316,18 @@ def build_step_packed(spec: LatticeSpec, agg_inputs: list[AggInput],
 def build_step_encoded(spec: LatticeSpec, agg_inputs: list[AggInput],
                        filter_fn: ValueFn | None, combo, cap: int,
                        null_keys) -> Callable:
-    """step(state, watermark, n, dt_base, words u32) -> state' over the
-    bit-packed v2 transport (engine.transport): the column decode is
-    traced into the same jit as the scatter, so XLA fuses unpack shifts
-    with the aggregation. Null-flag streams absent from the wire are
-    constant-folded to all-False."""
+    """step(state, watermark, n, bases i32[streams], words u32) -> state'
+    over the bit-packed transport (engine.transport): the column decode
+    is traced into the same jit as the scatter, so XLA fuses unpack
+    shifts with the aggregation. Null-flag streams absent from the wire
+    are constant-folded to all-False."""
     from hstream_tpu.engine import transport as tp
 
     base = build_step_fn(spec, agg_inputs, filter_fn)
 
-    def step(state, watermark, n, dt_base, words):
+    def step(state, watermark, n, bases, words):
         key_ids, ts, valid, cols = tp.decode_batch(words, combo, cap, n,
-                                                   dt_base)
+                                                   bases)
         for nk in null_keys:
             if nk is not None and nk not in cols:
                 cols[nk] = jnp.zeros((cap,), jnp.bool_)

@@ -110,7 +110,7 @@ class ShardedQueryExecutor(QueryExecutor):
             return None
         ts = np.asarray(ts_ms, dtype=np.int64)
         return StagedBatch(
-            n=len(key_ids), cap=0, combo=None, dt_base=0, words=None,
+            n=len(key_ids), cap=0, combo=None, bases=None, words=None,
             epoch=0, ts_min=int(ts.min()), ts_max=int(ts.max()),
             key_ids=key_ids, ts_ms=ts, cols=cols, nulls=nulls)
 

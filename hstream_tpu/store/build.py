@@ -6,28 +6,15 @@ is newer — a dev-friendly analogue of the reference's cbits build
 from __future__ import annotations
 
 import os
-import subprocess
-import threading
+
+from hstream_tpu.common.nativebuild import build_so
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "cpp", "nstore.cpp")
 SO = os.path.join(_DIR, "cpp", "libnstore.so")
-_lock = threading.Lock()
 
 
 def build(force: bool = False) -> str:
     """Compile cpp/nstore.cpp -> cpp/libnstore.so if stale; returns the
     .so path."""
-    with _lock:
-        if (not force and os.path.exists(SO)
-                and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
-            return SO
-        tmp = SO + ".tmp"
-        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-               SRC, "-o", tmp, "-lz"]
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"native store build failed:\n{proc.stderr[-4000:]}")
-        os.replace(tmp, SO)
-        return SO
+    return build_so(SRC, SO, libs=("z",), force=force)
