@@ -186,6 +186,92 @@ def measure_rtt() -> float:
     return (time.perf_counter() - t0) / reps * 1e3
 
 
+def server_path_eps() -> dict:
+    """Measured Append -> push-query throughput through the REAL gRPC
+    server (loopback): the product path, not the library fast path.
+    Returns {"server_columnar_eps": ..., "server_json_eps": ...} —
+    columnar producer batches vs per-record JSON appends."""
+    import grpc
+
+    from hstream_tpu.common import records as rec
+    from hstream_tpu.proto import api_pb2 as pb
+    from hstream_tpu.proto.rpc import HStreamApiStub
+    from hstream_tpu.server.main import serve
+
+    server, ctx = serve("127.0.0.1", 0, "mem://")
+    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(ch)
+    out: dict[str, float] = {}
+    try:
+        stub.CreateStream(pb.Stream(stream_name="bsrc"))
+        q = stub.CreateQuery(pb.CreateQueryRequest(
+            query_text="SELECT device, COUNT(*) AS c, SUM(temp) AS s "
+                       "FROM bsrc GROUP BY device, "
+                       "TUMBLING (INTERVAL 10 SECOND) "
+                       "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;"))
+        time.sleep(0.5)  # task attach
+        task = ctx.running_queries[q.id]
+        rng = np.random.default_rng(1)
+
+        def drain_to(ts_target: float) -> None:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                ex = task.executor
+                if ex is not None and ex.watermark_abs >= ts_target:
+                    return
+                time.sleep(0.02)
+            raise TimeoutError("server path did not drain")
+
+        # columnar producer batches
+        n, batches = 1 << 18, 12
+        base = 1_700_000_000_000
+        devs = np.array([f"d{k}" for k in range(N_KEYS)])
+        payloads = []
+        for b in range(batches + 2):
+            ts = base + b * 200 + np.sort(rng.integers(0, 200, n))
+            payloads.append((int(ts[-1]), rec.build_columnar_record(
+                ts.astype(np.int64),
+                {"device": devs[rng.integers(0, N_KEYS, n)],
+                 "temp": (np.rint(rng.normal(20, 5, n) * 10)
+                          .astype(np.float32) * np.float32(0.1))})))
+        for last, p in payloads[:2]:  # warmup (compile)
+            req = pb.AppendRequest(stream_name="bsrc")
+            req.records.append(p)
+            stub.Append(req)
+        drain_to(payloads[1][0])
+        t0 = time.perf_counter()
+        for last, p in payloads[2:]:
+            req = pb.AppendRequest(stream_name="bsrc")
+            req.records.append(p)
+            stub.Append(req)
+        drain_to(payloads[-1][0])
+        out["server_columnar_eps"] = round(
+            batches * n / (time.perf_counter() - t0))
+
+        # per-record JSON appends (the reference-style path)
+        jn, jb = 1000, 20
+        base2 = base + 10 * 60_000
+        reqs = []
+        for b in range(jb):
+            req = pb.AppendRequest(stream_name="bsrc")
+            for i in range(jn):
+                req.records.append(rec.build_record(
+                    {"device": f"d{i % N_KEYS}", "temp": 21.5},
+                    publish_time_ms=base2 + b * 200 + i // 5))
+            reqs.append((base2 + b * 200 + (jn - 1) // 5, req))
+        t0 = time.perf_counter()
+        for last, req in reqs:
+            stub.Append(req)
+        drain_to(reqs[-1][0])
+        out["server_json_eps"] = round(
+            jb * jn / (time.perf_counter() - t0))
+    finally:
+        ch.close()
+        server.stop(grace=1)
+        ctx.shutdown()
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -243,6 +329,7 @@ def main() -> None:
         "rtt_ms": round(rtt_ms, 1),
         "platform": jax.devices()[0].platform,
     }
+    result.update(server_path_eps())
     print(json.dumps(result))
     pipe.close()
 

@@ -1,0 +1,101 @@
+"""Columnar batch payload: the high-throughput producer format.
+
+A RAW-flagged HStreamRecord whose payload starts with the HSCB1 magic
+carries a whole COLUMN-oriented event batch: one i64 timestamp array
+plus named columns (f32 / i64 / bool / dictionary-encoded strings).
+Appending one columnar record per micro-batch skips per-event protobuf
+and JSON entirely — the server's query tasks detect the magic and feed
+the columns straight into the jitted lattice step (engine ingest
+contract), the path the 10M events/s target is specified against.
+
+The reference's wire is one protobuf per event (BuildRecord.hs:28-70);
+this is the TPU-first divergence SURVEY §7 prescribes ("protobuf decode
++ key dictionary off the critical path — columnar staging").
+
+Layout: MAGIC | u32 header_len | header JSON | ts i64[n] | col bytes...
+header: {"n": int, "cols": [[name, kind], ...], "dicts": {name: [str]}}
+kinds: "f32" | "i64" | "bool" | "str" (i32 ids into header dict)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+
+import numpy as np
+
+MAGIC = b"HSCB1\x00"
+
+_KIND_DTYPE = {"f32": np.float32, "i64": np.int64, "bool": np.uint8,
+               "str": np.int32}
+
+
+def is_columnar(payload: bytes) -> bool:
+    return payload[: len(MAGIC)] == MAGIC
+
+
+def encode_columnar(ts_ms: np.ndarray,
+                    cols: Mapping[str, np.ndarray | list],
+                    ) -> bytes:
+    """Columns -> payload bytes. String columns (lists or object/str
+    arrays) are dictionary-encoded; numeric arrays are cast to
+    f32/i64/bool."""
+    ts = np.ascontiguousarray(ts_ms, np.int64)
+    n = len(ts)
+    meta_cols: list[list[str]] = []
+    dicts: dict[str, list[str]] = {}
+    bufs: list[bytes] = [ts.tobytes()]
+    for name, v in cols.items():
+        arr = np.asarray(v)
+        if arr.dtype.kind in ("U", "S", "O"):
+            uniq, inv = np.unique(arr.astype(str), return_inverse=True)
+            dicts[name] = uniq.tolist()
+            data = inv.astype(np.int32)
+            kind = "str"
+        elif arr.dtype.kind == "b":
+            data = arr.astype(np.uint8)
+            kind = "bool"
+        elif arr.dtype.kind in ("i", "u"):
+            data = arr.astype(np.int64)
+            kind = "i64"
+        else:
+            data = arr.astype(np.float32)
+            kind = "f32"
+        if len(data) != n:
+            raise ValueError(f"column {name!r} length {len(data)} != {n}")
+        meta_cols.append([name, kind])
+        bufs.append(np.ascontiguousarray(data).tobytes())
+    header = json.dumps({"n": n, "cols": meta_cols, "dicts": dicts},
+                        separators=(",", ":")).encode()
+    out = bytearray(MAGIC)
+    out += np.uint32(len(header)).tobytes()
+    out += header
+    for b in bufs:
+        out += b
+    return bytes(out)
+
+
+def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
+    """payload -> (ts i64[n], {name: (kind, array, dict|None)}).
+
+    Arrays are zero-copy views into the payload where alignment allows.
+    """
+    if not is_columnar(payload):
+        raise ValueError("not a columnar payload")
+    off = len(MAGIC)
+    hlen = int(np.frombuffer(payload, np.uint32, 1, off)[0])
+    off += 4
+    header = json.loads(payload[off: off + hlen])
+    off += hlen
+    n = header["n"]
+    ts = np.frombuffer(payload, np.int64, n, off)
+    off += 8 * n
+    cols: dict[str, Any] = {}
+    for name, kind in header["cols"]:
+        dt = _KIND_DTYPE[kind]
+        arr = np.frombuffer(payload, dt, n, off)
+        off += arr.itemsize * n
+        if kind == "bool":
+            arr = arr.astype(np.bool_)
+        cols[name] = (kind, arr, header["dicts"].get(name))
+    return ts, cols

@@ -76,6 +76,16 @@ def build_record(
     return pb.HStreamRecord(header=header, payload=body)
 
 
+def build_columnar_record(ts_ms, cols, *, key: str = "") -> pb.HStreamRecord:
+    """One RAW record carrying a whole columnar event batch (the
+    high-throughput producer path — common/columnar.py)."""
+    from hstream_tpu.common import columnar
+
+    payload = columnar.encode_columnar(ts_ms, cols)
+    last = int(ts_ms[-1]) if len(ts_ms) else None
+    return build_record(payload, key=key, publish_time_ms=last)
+
+
 def parse_record(data: bytes) -> pb.HStreamRecord:
     return pb.HStreamRecord.FromString(data)
 

@@ -202,8 +202,13 @@ class QueryExecutor:
         self._layout = tuple(
             (name, lattice.layout_tag(self.schema.type_of(name)))
             for name in self._needed_cols)
+        # changelog extraction is bounded by the touched-pair space
+        # (n_keys * n_slots), usually far below batch capacity — keeps
+        # the per-batch device->host extract buffer small
+        max_out = min(self.batch_capacity * n_per,
+                      self.spec.n_keys * self.spec.n_slots)
         fns = lattice.compiled(self.spec, self.schema, self._filter_expr,
-                               self.batch_capacity * n_per, self._layout)
+                               max_out, self._layout)
         self._extract_slot = fns.extract_slot
         self._reset_slot = fns.reset_slot
         self._extract_touched = fns.extract_touched

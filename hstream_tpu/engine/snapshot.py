@@ -161,6 +161,7 @@ def _lattice_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
     meta = {
         "kind": "lattice",
         "n_keys": ex.spec.n_keys,
+        "batch_capacity": ex.batch_capacity,
         "epoch": ex.epoch,
         "watermark_abs": ex.watermark_abs,
         "emit_changes": ex.emit_changes,
@@ -182,7 +183,8 @@ def _restore_lattice(node, meta, arrays, *, batch_capacity: int = 4096):
     schema = Schema(tuple((n, ColumnType(t)) for n, t in meta["schema"]))
     ex = QueryExecutor(node, schema, emit_changes=meta["emit_changes"],
                        initial_keys=meta["n_keys"],
-                       batch_capacity=batch_capacity)
+                       batch_capacity=meta.get("batch_capacity",
+                                               batch_capacity))
     # __init__ re-encodes string literals deterministically (same node,
     # same schema => same dictionary prefix), so overwriting the dict
     # contents with the snapshot's (literals + runtime values, in the
@@ -245,6 +247,7 @@ def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
 
     meta = {
         "kind": "join",
+        "batch_capacity": ex._batch_capacity,
         "watermark": ex.watermark,
         "stores": {side: dump_store(st)
                    for side, st in ex._stores.items()},
@@ -261,7 +264,8 @@ def _restore_join(plan, meta, arrays, *, initial_keys: int,
     from hstream_tpu.engine.join import JoinExecutor, _SideStore
 
     ex = JoinExecutor(plan, initial_keys=initial_keys,
-                      batch_capacity=batch_capacity)
+                      batch_capacity=meta.get("batch_capacity",
+                                              batch_capacity))
     ex.watermark = meta["watermark"]
     for side, ents in meta["stores"].items():
         st = _SideStore()

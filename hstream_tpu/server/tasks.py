@@ -26,6 +26,9 @@ import time
 import traceback
 from typing import Any, Callable
 
+import numpy as np
+
+from hstream_tpu.common import columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.engine.snapshot import (
@@ -225,21 +228,45 @@ class QueryTask(threading.Thread):
     def _process_batch(self, batch: DataBatch) -> None:
         rows: list[dict[str, Any]] = []
         ts: list[int] = []
+
+        def flush_rows() -> None:
+            if rows:
+                self._run_rows(rows.copy(), ts.copy(), batch)
+                rows.clear()
+                ts.clear()
+
         for payload in batch.payloads:
             r = rec.parse_record(payload)
+            if (r.header.flag == rec.pb.RECORD_FLAG_RAW
+                    and columnar.is_columnar(r.payload)):
+                # columnar batch payload: the high-throughput producer
+                # path — flush accumulated JSON rows first (order)
+                flush_rows()
+                self._run_columnar(r.payload, batch)
+                continue
             d = rec.record_to_dict(r)
             if d is None:
                 continue  # raw records skipped, like the reference's
                 # JSON-flag filter (HStore.hs:119-143)
             rows.append(d)
             ts.append(r.header.publish_time_ms or batch.append_time_ms)
-        if not rows:
-            return
+        flush_rows()
+
+    def _make_executor(self, sample_rows: list, first_n: int):
+        from hstream_tpu.engine.types import round_up_pow2
+        from hstream_tpu.sql.codegen import make_executor
+
+        # size the device batch to the producer's batch shape: a columnar
+        # producer sending 256k-row batches must not be split into 64
+        # separate device round-trips by the default 4096 capacity
+        cap = min(max(round_up_pow2(first_n, lo=4096), 4096), 1 << 19)
+        return make_executor(self.plan, sample_rows=sample_rows,
+                             batch_capacity=cap)
+
+    def _run_rows(self, rows: list, ts: list, batch: DataBatch) -> None:
         with self.state_lock:
             if self.executor is None:
-                from hstream_tpu.sql.codegen import make_executor
-
-                self.executor = make_executor(self.plan, sample_rows=rows)
+                self.executor = self._make_executor(rows, len(rows))
             if self.is_join:
                 out = self.executor.process(
                     rows, ts, stream=self._sources[batch.logid])
@@ -252,6 +279,145 @@ class QueryTask(threading.Thread):
             # the materialization lock before taking state_lock)
             if out:
                 self.sink(out)
+
+    # ---- columnar fast path ------------------------------------------------
+
+    def _run_columnar(self, payload: bytes, batch: DataBatch) -> None:
+        try:
+            ts, cols = columnar.decode_columnar(payload)
+            if len(ts) == 0:
+                return
+        except Exception:  # noqa: BLE001 — a malformed/forged payload
+            # must not kill the query task; skip it like any other
+            # unrecognized RAW record
+            log.warning("skipping malformed columnar record on logid %d",
+                        batch.logid)
+            return
+        with self.state_lock:
+            if self.executor is None:
+                self.executor = self._make_executor(
+                    _sample_rows(ts, cols), len(ts))
+            ex = self.executor
+            if self.is_join or not hasattr(ex, "process_columnar"):
+                # joins / sessions / stateless: row materialization
+                rws = _rows_from_columnar(ts, cols)
+                if self.is_join:
+                    out = ex.process(rws, ts.tolist(),
+                                     stream=self._sources[batch.logid])
+                else:
+                    out = ex.process(rws, ts.tolist())
+            else:
+                key_ids = _columnar_key_ids(ex, cols, len(ts))
+                dev_cols, nulls = _device_columns(ex, cols, len(ts))
+                out = ex.process_columnar(key_ids, ts, dev_cols, nulls)
+            if out:
+                self.sink(out)
+
+
+def _sample_rows(ts: "np.ndarray", cols: dict, k: int = 8) -> list[dict]:
+    n = min(int(len(ts)), k)
+    return _rows_from_columnar(
+        ts[:n], {name: (kind, arr[:n], d)
+                 for name, (kind, arr, d) in cols.items()})
+
+
+def _rows_from_columnar(ts: "np.ndarray", cols: dict) -> list[dict]:
+    host = {}
+    for name, (kind, arr, d) in cols.items():
+        if kind == "str":
+            host[name] = [d[int(i)] for i in arr]
+        else:
+            host[name] = arr.tolist()
+    names = list(host)
+    return [dict(zip(names, vals))
+            for vals in zip(*(host[c] for c in names))]
+
+
+def _columnar_key_ids(ex, cols: dict, n: int) -> "np.ndarray":
+    """Vectorized group-key encoding: per-column unique+inverse, then
+    one key_id_for call per DISTINCT combination (not per row)."""
+    if not ex.group_cols:
+        return np.zeros(n, np.int32)
+    col_vals: list[list] = []
+    col_codes: list[np.ndarray] = []
+    for c in ex.group_cols:
+        ent = cols.get(c)
+        if ent is None:
+            col_vals.append([None])
+            col_codes.append(np.zeros(n, np.int64))
+            continue
+        kind, arr, d = ent
+        uniq, codes = np.unique(arr, return_inverse=True)
+        if kind == "str":
+            vals = [d[int(u)] for u in uniq]
+        elif kind == "bool":
+            vals = [bool(u) for u in uniq]
+        elif kind == "f32":
+            vals = [float(u) for u in uniq]
+        else:
+            vals = [int(u) for u in uniq]
+        col_vals.append(vals)
+        col_codes.append(codes.astype(np.int64))
+    radix = 1
+    for vals in col_vals:
+        radix *= max(len(vals), 1)
+    if radix >= (1 << 62):
+        # mixed-radix code would overflow int64 and silently collide
+        # distinct groups: fall back to per-row tuples (rare — several
+        # high-cardinality group columns in one batch)
+        arrs = [np.asarray(vals, object)[codes]
+                for vals, codes in zip(col_vals, col_codes)]
+        return np.fromiter((ex.key_id_for(t) for t in zip(*arrs)),
+                           np.int32, n)
+    combined = col_codes[0]
+    for codes, vals in zip(col_codes[1:], col_vals[1:]):
+        combined = combined * len(vals) + codes
+    u, inv = np.unique(combined, return_inverse=True)
+    kid_for_u = np.empty(len(u), np.int32)
+    for j, cu in enumerate(u.tolist()):
+        idxs = []
+        for vals in reversed(col_vals[1:]):
+            idxs.append(cu % len(vals))
+            cu //= len(vals)
+        idxs.append(cu)
+        idxs.reverse()
+        key = tuple(col_vals[k][i] for k, i in enumerate(idxs))
+        kid_for_u[j] = ex.key_id_for(key)
+    return kid_for_u[inv]
+
+
+def _device_columns(ex, cols: dict, n: int):
+    """Map batch columns to the executor's needed device columns;
+    missing columns become all-NULL."""
+    from hstream_tpu.engine.types import ColumnType
+
+    dev: dict[str, Any] = {}
+    nulls: dict[str, Any] = {}
+    for name in ex._needed_cols:
+        ent = cols.get(name)
+        want = ex.schema.type_of(name)
+        # type mismatch between the batch column and the bound schema
+        # (e.g. a later producer sends strings where FLOAT was inferred)
+        # becomes NULL, never dictionary ids masquerading as data
+        kind = ent[0] if ent is not None else None
+        mismatch = (kind == "str") != (want == ColumnType.STRING)
+        if ent is None or mismatch:
+            dev[name] = np.zeros(
+                n, np.int32 if want == ColumnType.STRING else np.float32)
+            nulls[name] = np.ones(n, np.bool_)
+            continue
+        kind, arr, d = ent
+        if want == ColumnType.STRING:
+            lut = np.asarray([ex.dicts[name].encode(s) for s in d],
+                             np.int32)
+            dev[name] = lut[arr]
+        elif want == ColumnType.BOOL:
+            dev[name] = np.asarray(arr, np.bool_)
+        elif want == ColumnType.INT:
+            dev[name] = np.asarray(arr, np.int32)
+        else:
+            dev[name] = np.asarray(arr, np.float32)
+    return dev, (nulls or None)
 
 
 def stream_sink(ctx, sink_stream: str,
