@@ -186,6 +186,161 @@ def measure_rtt() -> float:
     return (time.perf_counter() - t0) / reps * 1e3
 
 
+def bench_config2_hop_multi() -> dict:
+    """BASELINE config 2: HOP(60s,10s) AVG/MIN/MAX multi-agg, 1k keys."""
+    from hstream_tpu.engine import (
+        AggKind, AggSpec, AggregateNode, ColumnType, HoppingWindow,
+        QueryExecutor, Schema, SourceNode,
+    )
+    from hstream_tpu.engine.expr import Col
+    from hstream_tpu.engine.pipeline import IngestPipeline
+
+    schema = Schema.of(device=ColumnType.STRING, v=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("s", schema), group_keys=[Col("device")],
+        window=HoppingWindow(60_000, 10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.AVG, "avg", input=Col("v")),
+              AggSpec(AggKind.MIN, "lo", input=Col("v")),
+              AggSpec(AggKind.MAX, "hi", input=Col("v"))])
+    ex = QueryExecutor(node, schema, emit_changes=False,
+                       initial_keys=1024, batch_capacity=BATCH)
+    ex.defer_close_decode = True
+    for k in range(N_KEYS):
+        ex.key_id_for((f"d{k}",))
+    pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH)
+    src = BatchSource(seed=2)
+    warm, meas = 12, 40
+    for _ in range(warm):
+        kids, ts, cols = src.next()
+        pipe.submit(kids, ts, {"v": cols["temp"]})
+    pipe.flush()
+    ex.drain_closed()
+    force(ex)
+    t0 = time.perf_counter()
+    for _ in range(meas):
+        kids, ts, cols = src.next()
+        pipe.submit(kids, ts, {"v": cols["temp"]})
+    pipe.flush()
+    rows = len(ex.drain_closed())
+    force(ex)
+    dt = time.perf_counter() - t0
+    pipe.close()
+    return {"events_per_sec": round(meas * BATCH / dt),
+            "emitted_rows": rows}
+
+
+def bench_config4_session_quantile() -> dict:
+    """BASELINE config 4: APPROX_QUANTILE p50/p99 over session windows
+    (host-merge engine — segmentation vectorized, merges host-side)."""
+    from hstream_tpu.engine import ColumnType, Schema
+    from hstream_tpu.engine.expr import Col
+    from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec, \
+        SourceNode
+    from hstream_tpu.engine.session import SessionExecutor
+    from hstream_tpu.engine.window import SessionWindow
+
+    schema = Schema.of(user=ColumnType.STRING, lat=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("s", schema), group_keys=[Col("user")],
+        window=SessionWindow(5_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.APPROX_QUANTILE, "p50", input=Col("lat"),
+                      quantile=0.5),
+              AggSpec(AggKind.APPROX_QUANTILE, "p99", input=Col("lat"),
+                      quantile=0.99)])
+    ex = SessionExecutor(node, schema, emit_changes=False)
+    rng = np.random.default_rng(4)
+    n, batches = 4096, 25
+    base = 1_700_000_000_000
+    stride = 20_000  # > 2*gap: prior sessions close every batch
+    rows_in = [[{"user": f"u{int(u)}", "lat": float(v)}
+                for u, v in zip(rng.integers(0, 200, n),
+                                np.abs(rng.normal(50, 20, n)))]
+               for _ in range(batches + 5)]
+    for b in range(5):
+        ex.process(rows_in[b], [base + b * stride + i % 1000
+                                for i in range(n)])
+    t0 = time.perf_counter()
+    emitted = 0
+    for b in range(5, batches + 5):
+        out = ex.process(rows_in[b], [base + b * stride + i % 1000
+                                      for i in range(n)])
+        emitted += len(out)
+    dt = time.perf_counter() - t0
+    return {"events_per_sec": round(batches * n / dt),
+            "emitted_rows": emitted}
+
+
+def bench_config5_join_view() -> dict:
+    """BASELINE config 5: stream-stream interval JOIN + GROUP BY into a
+    materialized view (host two-sided state + device aggregation)."""
+    from hstream_tpu.sql.codegen import make_executor, stream_codegen
+
+    plan = stream_codegen(
+        "SELECT l.k, COUNT(*) AS c FROM l INNER JOIN r "
+        "WITHIN (INTERVAL 1 SECOND) ON l.k = r.k "
+        "GROUP BY l.k, TUMBLING (INTERVAL 10 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
+    ex = make_executor(plan, sample_rows=[{"k": "k0", "x": 1.0}])
+    rng = np.random.default_rng(5)
+    n, batches = 2048, 20
+    base = 1_700_000_000_000
+
+    def mk(b):
+        return ([{"k": f"k{int(i)}", "x": 1.0}
+                 for i in rng.integers(0, 1000, n)],
+                [base + b * 500 + i % 500 for i in range(n)])
+
+    joined = 0
+    for b in range(4):  # warmup/compile
+        rows, ts = mk(b)
+        ex.process(rows, ts, stream="l" if b % 2 else "r")
+    t0 = time.perf_counter()
+    for b in range(4, batches + 4):
+        rows, ts = mk(b)
+        out = ex.process(rows, ts, stream="l" if b % 2 else "r")
+        joined += len(out)
+    dt = time.perf_counter() - t0
+    return {"events_per_sec": round(batches * n / dt),
+            "change_rows_per_sec": round(joined / dt)}
+
+
+def bench_store_append(tmpdir: str) -> dict:
+    """Native store append bench (the reference's writeBench.hs:29-60
+    analogue): records/s, MB/s, avg/p99 append latency."""
+    import shutil
+
+    from hstream_tpu.store import open_store
+
+    path = tmpdir + "/benchstore"
+    shutil.rmtree(path, ignore_errors=True)
+    store = open_store(path)
+    try:
+        store.create_log(4242)
+        payload = bytes(256)
+        batch = [payload] * 100
+        for _ in range(20):  # warmup
+            store.append_batch(4242, batch)
+        lat = []
+        t0 = time.perf_counter()
+        n_batches = 400
+        for _ in range(n_batches):
+            t1 = time.perf_counter()
+            store.append_batch(4242, batch)
+            lat.append(time.perf_counter() - t1)
+        dt = time.perf_counter() - t0
+        recs = n_batches * len(batch)
+        return {
+            "records_per_sec": round(recs / dt),
+            "mb_per_sec": round(recs * len(payload) / dt / 1e6, 1),
+            "avg_append_ms": round(float(np.mean(lat)) * 1e3, 3),
+            "p99_append_ms": round(float(np.percentile(lat, 99)) * 1e3,
+                                   3),
+        }
+    finally:
+        store.close()
+        shutil.rmtree(path, ignore_errors=True)
+
+
 def server_path_eps() -> dict:
     """Measured Append -> push-query throughput through the REAL gRPC
     server (loopback): the product path, not the library fast path.
@@ -330,6 +485,14 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
     }
     result.update(server_path_eps())
+    import tempfile
+
+    result["configs"] = {
+        "hop_multi_agg": bench_config2_hop_multi(),
+        "session_quantile": bench_config4_session_quantile(),
+        "join_groupby": bench_config5_join_view(),
+        "store_append": bench_store_append(tempfile.gettempdir()),
+    }
     print(json.dumps(result))
     pipe.close()
 

@@ -83,11 +83,25 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
     if not is_columnar(payload):
         raise ValueError("not a columnar payload")
     off = len(MAGIC)
+    if len(payload) < off + 4:
+        raise ValueError("truncated columnar header")
     hlen = int(np.frombuffer(payload, np.uint32, 1, off)[0])
     off += 4
     header = json.loads(payload[off: off + hlen])
     off += hlen
     n = header["n"]
+    # forged headers must fail HERE, not deep inside the engine: a
+    # negative n would make frombuffer read "the rest", a giant n would
+    # over-read; both are rejected by explicit bounds checks
+    if not isinstance(n, int) or n < 0:
+        raise ValueError(f"bad columnar n={n!r}")
+    need = 8 * n
+    for _, kind in header["cols"]:
+        if kind not in _KIND_DTYPE:
+            raise ValueError(f"unknown column kind {kind!r}")
+        need += np.dtype(_KIND_DTYPE[kind]).itemsize * n
+    if len(payload) - off < need:
+        raise ValueError("columnar payload shorter than header claims")
     ts = np.frombuffer(payload, np.int64, n, off)
     off += 8 * n
     cols: dict[str, Any] = {}
@@ -97,5 +111,12 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
         off += arr.itemsize * n
         if kind == "bool":
             arr = arr.astype(np.bool_)
-        cols[name] = (kind, arr, header["dicts"].get(name))
+        d = header["dicts"].get(name)
+        if kind == "str":
+            if not isinstance(d, list):
+                raise ValueError(f"string column {name!r} missing dict")
+            if n and (int(arr.min()) < 0 or int(arr.max()) >= len(d)):
+                raise ValueError(
+                    f"string column {name!r} ids out of dict range")
+        cols[name] = (kind, arr, d)
     return ts, cols

@@ -20,8 +20,10 @@ import threading
 import traceback
 from typing import Any, Mapping
 
+from hstream_tpu.common import columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.errors import ServerError
+from hstream_tpu.server import tasks
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.common.records import flatten_json
 from hstream_tpu.server.persistence import TaskStatus
@@ -176,7 +178,24 @@ class ConnectorTask(threading.Thread):
                 for r in results:
                     if isinstance(r, DataBatch):
                         for payload in r.payloads:
-                            d = rec.record_to_dict(rec.parse_record(payload))
+                            pr = rec.parse_record(payload)
+                            if (pr.header.flag == rec.pb.RECORD_FLAG_RAW
+                                    and columnar.is_columnar(pr.payload)):
+                                # columnar producer batches flow to
+                                # sinks too (same decode as query tasks)
+                                try:
+                                    ts, cols = columnar.decode_columnar(
+                                        pr.payload)
+                                    rows.extend(
+                                        tasks._rows_from_columnar(
+                                            ts, cols))
+                                except Exception:  # noqa: BLE001
+                                    log.warning(
+                                        "connector %s: skipping "
+                                        "malformed columnar record",
+                                        self.connector_id)
+                                continue
+                            d = rec.record_to_dict(pr)
                             if d is not None:
                                 rows.append(d)
                         last = max(last, r.lsn)

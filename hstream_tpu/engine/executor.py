@@ -48,6 +48,7 @@ from hstream_tpu.engine.types import (
     HostBatch,
     Schema,
     StringDictionary,
+    canon_key,
     round_up_pow2,
 )
 from hstream_tpu.engine.window import FixedWindow, SessionWindow
@@ -686,7 +687,10 @@ class QueryExecutor:
         return out
 
     def key_id_for(self, key: tuple) -> int:
-        """Dense id for a group-key tuple (columnar-path key dictionary)."""
+        """Dense id for a group-key tuple (columnar-path key dictionary).
+        Float key values are canonicalized through float32 so JSON and
+        columnar producers agree on group identity."""
+        key = canon_key(key)
         kid = self._key_ids.get(key)
         if kid is None:
             kid = len(self._key_rev)

@@ -26,6 +26,7 @@ from typing import Any, Mapping, Sequence
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
+from hstream_tpu.engine.types import canon_key
 from hstream_tpu.engine.window import DEFAULT_GRACE_MS
 
 
@@ -212,7 +213,7 @@ class JoinExecutor:
             return None
         if any(v is None for v in vals):
             return None
-        return vals
+        return canon_key(vals)
 
     # ---- ingest ------------------------------------------------------------
 

@@ -27,7 +27,7 @@ from hstream_tpu.engine.executor import QueryExecutor
 from hstream_tpu.engine.expr import eval_host
 from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec
 from hstream_tpu.engine.sketches import HLLConfig, QuantileConfig
-from hstream_tpu.engine.types import Schema
+from hstream_tpu.engine.types import Schema, canon_key
 from hstream_tpu.engine.window import SessionWindow
 
 
@@ -225,7 +225,7 @@ class SessionExecutor:
                         continue
                 except (TypeError, KeyError):
                     continue
-            key = tuple(row.get(c) for c in self.group_cols)
+            key = canon_key(tuple(row.get(c) for c in self.group_cols))
             sess_list = self.sessions.setdefault(key, [])
             # find sessions overlapping [ts - gap, ts + gap]
             overl = [s for s in sess_list

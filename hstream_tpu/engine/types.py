@@ -80,6 +80,17 @@ class StringDictionary:
         return len(self._values)
 
 
+def canon_key(key: tuple) -> tuple:
+    """Canonicalize a group-key tuple: float values round-trip through
+    float32 so JSON-producer rows (python floats) and columnar batches
+    (f32 columns) agree on group identity — 20.1 and f32(20.1) must be
+    ONE group, not two."""
+    if any(isinstance(v, float) for v in key):
+        return tuple(float(np.float32(v)) if isinstance(v, float) else v
+                     for v in key)
+    return key
+
+
 def round_up_pow2(n: int, lo: int = 256) -> int:
     cap = lo
     while cap < n:

@@ -153,6 +153,64 @@ def test_malformed_columnar_record_is_skipped(server_stub):
     assert task is not None and task.is_alive()
 
 
+def test_columnar_records_reach_connector_sink(server_stub, tmp_path):
+    """Connector sinks must consume columnar batches too, not silently
+    drop them while advancing the checkpoint."""
+    import sqlite3
+
+    stub, _ = server_stub
+    db = tmp_path / "colsink.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    conn.commit()
+    conn.close()
+    stub.CreateStream(pb.Stream(stream_name="colcsrc"))
+    stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text=f"CREATE SINK CONNECTOR colsc WITH "
+                  f"(type = 'sqlite', stream = 'colcsrc', "
+                  f"path = '{db}', table = 't');"))
+    _append_columnar(stub, "colcsrc", np.array([BASE, BASE + 1]),
+                     {"a": np.array([1, 2]), "b": ["x", "y"]})
+    deadline = time.time() + 15
+    rows = []
+    while time.time() < deadline:
+        conn = sqlite3.connect(db)
+        rows = conn.execute("SELECT a, b FROM t ORDER BY a").fetchall()
+        conn.close()
+        if len(rows) == 2:
+            break
+        time.sleep(0.2)
+    assert rows == [(1, "x"), (2, "y")]
+    stub.DeleteConnector(pb.DeleteConnectorRequest(id="colsc"))
+
+
+def test_float_group_key_consistent_across_formats(server_stub):
+    """A float GROUP BY value must land in ONE group whether it arrived
+    as a JSON python float or a columnar f32 (canon_key)."""
+    stub, _ = server_stub
+    stub.CreateStream(pb.Stream(stream_name="fkey"))
+    stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="CREATE VIEW fkeyv AS SELECT g, COUNT(*) AS c "
+                  "FROM fkey GROUP BY g, "
+                  "TUMBLING (INTERVAL 10 SECOND) "
+                  "GRACE BY INTERVAL 0 SECOND;"))
+    time.sleep(0.3)
+    req = pb.AppendRequest(stream_name="fkey")
+    req.records.append(rec.build_record({"g": 20.1},
+                                        publish_time_ms=BASE))
+    stub.Append(req)
+    _append_columnar(stub, "fkey", np.array([BASE + 1]),
+                     {"g": np.array([20.1], np.float32)})
+    _append_columnar(stub, "fkey", np.array([BASE + 30_000]),
+                     {"g": np.array([0.0], np.float32)})
+    rows = _view_rows(
+        stub, "fkeyv",
+        lambda rs: any(r.get("c") == 2 for r in rs
+                       if r.get("winStart") == BASE))
+    closed = [r for r in rows if r.get("winStart") == BASE]
+    assert len(closed) == 1 and closed[0]["c"] == 2, rows
+
+
 def test_columnar_numeric_group_key(server_stub):
     stub, _ = server_stub
     stub.CreateStream(pb.Stream(stream_name="numcol"))
