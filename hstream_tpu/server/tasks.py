@@ -217,6 +217,12 @@ class QueryTask(threading.Thread):
                 extra["sink"] = self.sink_dump()
             meta, arrays = capture_executor(self.executor, extra)
         blob = serialize_capture(meta, arrays)
+        # durability barrier: async sink appends must land before the
+        # checkpoint advances, or a crash could lose emitted rows that
+        # the restored state will never regenerate
+        flush = getattr(self.sink, "flush", None)
+        if flush is not None:
+            flush()
         self.ctx.store.meta_put(snapshot_key(self.info.query_id), blob)
         if self._reader is not None and self._pending_ckps:
             self._reader.write_checkpoints(self._pending_ckps)
@@ -423,12 +429,31 @@ def _device_columns(ex, cols: dict, n: int):
 def stream_sink(ctx, sink_stream: str,
                 stream_type: StreamType = StreamType.STREAM) -> SinkFn:
     """Sink emitting rows as JSON records onto a stream (the reference's
-    internal sink processor, HStore.hs:152-163)."""
+    internal sink processor, HStore.hs:152-163).
+
+    On the native store the appends go through the async completion
+    queue (the reference's async writer, hs_writer.cpp:29-51): the query
+    loop overlaps durable sink writes with the next batch's processing,
+    bounded in flight. `sink.flush()` is the durability barrier — the
+    task calls it before committing a state snapshot, so a checkpoint
+    never outruns its emitted rows."""
     logid = ctx.streams.get_logid(sink_stream, stream_type)
+    use_async = hasattr(ctx.store, "append_async")
+    pending: list = []
 
     def sink(rows: list[dict[str, Any]]) -> None:
         payloads = [rec.build_record(row).SerializeToString()
                     for row in rows]
-        ctx.store.append_batch(logid, payloads)
+        if use_async:
+            while len(pending) >= 8:  # bound in-flight appends
+                pending.pop(0).result()
+            pending.append(ctx.store.append_async(logid, payloads))
+        else:
+            ctx.store.append_batch(logid, payloads)
 
+    def flush() -> None:
+        while pending:
+            pending.pop(0).result()
+
+    sink.flush = flush
     return sink

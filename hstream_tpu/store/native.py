@@ -130,6 +130,7 @@ class NativeLogStore(LogStore):
             self._lib.ns_set_seg_bytes(self._h, segment_bytes)
         self._closed = False
         self._appender: AsyncAppender | None = None
+        self._appender_lock = threading.Lock()
 
     # ---- lifecycle ----
     def create_log(self, logid: int, attrs: LogAttrs | None = None) -> None:
@@ -195,7 +196,11 @@ class NativeLogStore(LogStore):
         """Queue an append; the returned future resolves to the LSN after
         the batch is durably written (C++ completion queue)."""
         if self._appender is None:
-            self._appender = AsyncAppender(self)
+            # locked: two tasks racing first use must share ONE appender
+            # (two would collide token counters on the one C++ queue)
+            with self._appender_lock:
+                if self._appender is None:
+                    self._appender = AsyncAppender(self)
         return self._appender.submit(logid, payloads, compression)
 
     # ---- introspection ----
