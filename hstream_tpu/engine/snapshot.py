@@ -129,9 +129,12 @@ def snapshot_executor(ex, extra: dict | None = None) -> bytes:
 
 
 def restore_executor(plan, blob: bytes, *, initial_keys: int = 1024,
-                     batch_capacity: int = 4096):
+                     batch_capacity: int = 4096, mesh=None):
     """Rebuild an executor from a snapshot blob for a lowered SELECT
-    plan. Returns (executor, extra)."""
+    plan. Returns (executor, extra). With `mesh`, lattice state restores
+    into a ShardedQueryExecutor (snapshots are mesh-portable: capture
+    merges shard partials into ONE canonical lattice, restore scatters
+    it back — see _scatter_state)."""
     meta, arrays = _unpack(blob)
     kind = meta["kind"]
     if kind == "join":
@@ -140,7 +143,7 @@ def restore_executor(plan, blob: bytes, *, initial_keys: int = 1024,
                            batch_capacity=batch_capacity)
     elif kind == "lattice":
         ex = _restore_lattice(plan.node, meta, arrays,
-                              batch_capacity=batch_capacity)
+                              batch_capacity=batch_capacity, mesh=mesh)
     elif kind == "session":
         ex = _restore_session(plan.node, meta)
     elif kind == "stateless":
@@ -172,19 +175,61 @@ def _lattice_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
         "schema": [[n, t.value] for n, t in ex.schema.fields],
     }
     # by reference: jax arrays are immutable; np.asarray (the device sync)
-    # happens in serialize_capture, outside the caller's lock
-    arrays = {f"s/{k}": v for k, v in ex.state.items()}
+    # happens in serialize_capture, outside the caller's lock.
+    # Sharded executors (leading data axis on every plane) canonicalize:
+    # merge the partial lattices with each plane's monoid op so the blob
+    # is mesh-portable (restorable single-chip or onto any mesh).
+    if hasattr(ex, "_sharded"):
+        arrays = {f"s/{k}": v
+                  for k, v in _merge_partials(ex).items()}
+    else:
+        arrays = {f"s/{k}": v for k, v in ex.state.items()}
     return meta, arrays
 
 
-def _restore_lattice(node, meta, arrays, *, batch_capacity: int = 4096):
+def _merge_partials(ex) -> dict[str, Any]:
+    """Reduce the leading data axis of a sharded executor's state with
+    each plane's merge monoid -> canonical [K, W, ...] state (exact: all
+    accumulators are commutative monoids, lattice.plane_merge_kinds).
+
+    The reductions are DISPATCHED on device (jnp, async) so this stays
+    cheap under the caller's state lock; the host sync happens in
+    serialize_capture's np.asarray, outside the lock."""
+    import jax.numpy as jnp
+
+    from hstream_tpu.engine import lattice
+
+    kinds = lattice.plane_merge_kinds(ex.spec)
+    out = {}
+    for k, v in ex.state.items():
+        kind = kinds.get(k, "sum")
+        if kind == "min":
+            out[k] = jnp.min(v, axis=0)
+        elif kind == "max":
+            out[k] = (jnp.any(v, axis=0) if v.dtype == jnp.bool_
+                      else jnp.max(v, axis=0).astype(v.dtype))
+        else:
+            out[k] = jnp.sum(v, axis=0).astype(v.dtype)
+    return out
+
+
+def _restore_lattice(node, meta, arrays, *, batch_capacity: int = 4096,
+                     mesh=None):
     from hstream_tpu.engine.executor import QueryExecutor, _OpenWindow
 
     schema = Schema(tuple((n, ColumnType(t)) for n, t in meta["schema"]))
-    ex = QueryExecutor(node, schema, emit_changes=meta["emit_changes"],
-                       initial_keys=meta["n_keys"],
-                       batch_capacity=meta.get("batch_capacity",
-                                               batch_capacity))
+    cap = meta.get("batch_capacity", batch_capacity)
+    if mesh is not None:
+        from hstream_tpu.parallel import ShardedQueryExecutor
+
+        ex = ShardedQueryExecutor(
+            node, schema, mesh=mesh, emit_changes=meta["emit_changes"],
+            initial_keys=meta["n_keys"], batch_capacity=cap)
+    else:
+        ex = QueryExecutor(node, schema,
+                           emit_changes=meta["emit_changes"],
+                           initial_keys=meta["n_keys"],
+                           batch_capacity=cap)
     # __init__ re-encodes string literals deterministically (same node,
     # same schema => same dictionary prefix), so overwriting the dict
     # contents with the snapshot's (literals + runtime values, in the
@@ -201,9 +246,32 @@ def _restore_lattice(node, meta, arrays, *, batch_capacity: int = 4096):
     ex._open = {s: _OpenWindow(start_abs=s, slot=slot)
                 for s, slot in meta["open"]}
     ex._null_sticky = set(meta["null_sticky"])
-    ex.state = {k[len("s/"):]: jax.device_put(v)
-                for k, v in arrays.items() if k.startswith("s/")}
+    canonical = {k[len("s/"):]: v
+                 for k, v in arrays.items() if k.startswith("s/")}
+    if mesh is not None:
+        ex.state = _scatter_state(ex, canonical)
+    else:
+        ex.state = {k: jax.device_put(v) for k, v in canonical.items()}
     return ex
+
+
+def _scatter_state(ex, canonical: dict[str, np.ndarray]):
+    """Install a canonical (merged) lattice into a sharded executor:
+    data-shard 0 carries the whole canonical lattice, the other shards
+    carry merge identities — their monoid merge at drain points yields
+    exactly the canonical values."""
+    from hstream_tpu.engine import lattice
+
+    identities = lattice.init_state(ex.spec)
+    sh = ex._sharded
+    out = {}
+    for k, v in canonical.items():
+        ident = np.asarray(identities[k])
+        g = np.broadcast_to(ident[None],
+                            (sh.n_data,) + ident.shape).copy()
+        g[0] = v
+        out[k] = jax.device_put(g, sh.state_sharding(k))
+    return out
 
 
 # ---- session ----------------------------------------------------------------

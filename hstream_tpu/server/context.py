@@ -27,8 +27,12 @@ class ServerContext:
     def __init__(self, store: LogStore, *,
                  persistence: Persistence | None = None,
                  host: str = "127.0.0.1", port: int = 6570,
-                 server_id: int = 1, durable_meta: bool = True):
+                 server_id: int = 1, durable_meta: bool = True,
+                 mesh=None):
         self.store = store
+        # optional jax.sharding.Mesh: when set, eligible aggregate
+        # queries execute sharded over it (parallel.ShardedQueryExecutor)
+        self.mesh = mesh
         self.streams = StreamApi(store)
         self.streams.ensure_checkpoint_log()
         self.ckp_store = LogCheckpointStore(store)

@@ -21,12 +21,25 @@ from hstream_tpu.store import open_store
 log = get_logger("main")
 
 
+def _build_mesh(shape: str):
+    """'DxK' -> a (data, key) jax mesh over the first D*K devices."""
+    from hstream_tpu.parallel import make_mesh
+
+    n_data, _, n_key = shape.lower().partition("x")
+    return make_mesh(n_data=int(n_data), n_key=int(n_key or 1))
+
+
 def serve(host: str = "127.0.0.1", port: int = 6570,
-          store_uri: str = "mem://", *, max_workers: int = 32
+          store_uri: str = "mem://", *, max_workers: int = 32,
+          mesh_shape: str | None = None
           ) -> tuple[grpc.Server, ServerContext]:
-    """Start a server; returns (grpc_server, ctx). Caller owns shutdown."""
+    """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
+
+    `mesh_shape` ("DxK", e.g. "4x2") shards eligible aggregate queries
+    over a (data, key) device mesh (SURVEY §2.3)."""
     store = open_store(store_uri)
-    ctx = ServerContext(store, host=host, port=port)
+    mesh = _build_mesh(mesh_shape) if mesh_shape else None
+    ctx = ServerContext(store, host=host, port=port, mesh=mesh)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
@@ -54,9 +67,12 @@ def main(argv=None) -> None:
                     help="mem:// or a directory path for the native "
                          "durable store")
     ap.add_argument("--workers", type=int, default=32)
+    ap.add_argument("--mesh", default=None, metavar="DxK",
+                    help="shard aggregate queries over a (data, key) "
+                         "device mesh, e.g. 4x2 (needs D*K devices)")
     args = ap.parse_args(argv)
     server, ctx = serve(args.host, args.port, args.store,
-                        max_workers=args.workers)
+                        max_workers=args.workers, mesh_shape=args.mesh)
     stop = {"flag": False}
 
     def on_signal(signum, frame):

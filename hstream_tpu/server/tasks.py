@@ -176,7 +176,8 @@ class QueryTask(threading.Thread):
         if blob is None:
             return None
         with self.state_lock:
-            self.executor, extra = restore_executor(self.plan, blob)
+            self.executor, extra = restore_executor(
+                self.plan, blob, mesh=self._query_mesh())
             if self.sink_load is not None and "sink" in extra:
                 self.sink_load(extra["sink"])
         ckps = {int(k): int(v) for k, v in extra.get("ckps", {}).items()}
@@ -258,6 +259,14 @@ class QueryTask(threading.Thread):
             ts.append(r.header.publish_time_ms or batch.append_time_ms)
         flush_rows()
 
+    def _query_mesh(self):
+        """The server mesh, when this plan can execute sharded (joins
+        stay single-chip; session plans ignore the mesh downstream)."""
+        mesh = getattr(self.ctx, "mesh", None)
+        if mesh is None or self.plan.join is not None:
+            return None
+        return mesh
+
     def _make_executor(self, sample_rows: list, first_n: int):
         from hstream_tpu.engine.types import round_up_pow2
         from hstream_tpu.sql.codegen import make_executor
@@ -267,7 +276,7 @@ class QueryTask(threading.Thread):
         # separate device round-trips by the default 4096 capacity
         cap = min(max(round_up_pow2(first_n, lo=4096), 4096), 1 << 19)
         return make_executor(self.plan, sample_rows=sample_rows,
-                             batch_capacity=cap)
+                             batch_capacity=cap, mesh=self._query_mesh())
 
     def _run_rows(self, rows: list, ts: list, batch: DataBatch) -> None:
         with self.state_lock:
