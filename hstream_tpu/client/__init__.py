@@ -1,0 +1,190 @@
+"""Interactive SQL REPL client.
+
+Reference: the haskeline REPL in hstream/app/client.hs (216 LoC) —
+reads SQL until ';', parses LOCALLY to classify the statement, then
+routes: push queries (SELECT ... EMIT CHANGES) stream results over the
+server-streaming RPC until Ctrl-C cancels (client.hs:117-132); DDL and
+everything else go through dedicated RPCs / ExecuteQuery
+(client.hs:91-116). Results render as aligned tables (the reference's
+Format.hs table rendering).
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Any, Iterable
+
+import grpc
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.common.errors import SQLError
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+from hstream_tpu.sql import plans
+from hstream_tpu.sql.codegen import stream_codegen
+
+BANNER = """hstream-tpu SQL shell — end with ';', Ctrl-C cancels a \
+streaming query, \\q quits."""
+PROMPT = "hstream> "
+CONT = "       > "
+
+
+def format_table(rows: list[dict[str, Any]]) -> str:
+    """Aligned-column rendering (reference Format.hs)."""
+    if not rows:
+        return "(0 rows)"
+    cols: list[str] = []
+    for row in rows:
+        for k in row:
+            if k not in cols:
+                cols.append(k)
+    cells = [[_show(row.get(c)) for c in cols] for row in rows]
+    widths = [max(len(c), *(len(r[i]) for r in cells))
+              for i, c in enumerate(cols)]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep,
+           "|" + "|".join(f" {c:<{w}} " for c, w in zip(cols, widths))
+           + "|", sep]
+    for r in cells:
+        out.append("|" + "|".join(
+            f" {v:<{w}} " for v, w in zip(r, widths)) + "|")
+    out.append(sep)
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+def _show(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, float) and math.isfinite(v) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+class Client:
+    """One connected SQL shell session."""
+
+    def __init__(self, addr: str, out=None):
+        self.channel = grpc.insecure_channel(addr)
+        self.stub = HStreamApiStub(self.channel)
+        self.out = out or sys.stdout
+
+    def close(self) -> None:
+        self.channel.close()
+
+    # ---- statement routing (client.hs:91-132) ---------------------------
+
+    def execute(self, sql: str) -> None:
+        try:
+            plan = stream_codegen(sql)  # local parse first
+        except SQLError as e:
+            print(f"parse error: {e}", file=self.out)
+            return
+        try:
+            if isinstance(plan, plans.SelectPlan) and plan.emit_changes:
+                self._push_query(sql)
+            elif isinstance(plan, plans.CreateViewPlan):
+                v = self.stub.CreateView(pb.CreateViewRequest(sql=sql))
+                print(f"view {v.view_id} created", file=self.out)
+            elif isinstance(plan, plans.CreateSinkConnectorPlan):
+                c = self.stub.CreateSinkConnector(
+                    pb.CreateSinkConnectorRequest(config=sql))
+                print(f"connector {c.id} created", file=self.out)
+            elif isinstance(plan, plans.CreatePlan):
+                self.stub.CreateStream(pb.Stream(
+                    stream_name=plan.stream, replication_factor=1))
+                print(f"stream {plan.stream} created", file=self.out)
+            elif isinstance(plan, plans.TerminatePlan):
+                req = (pb.TerminateQueriesRequest(all=True)
+                       if plan.query_id is None else
+                       pb.TerminateQueriesRequest(
+                           query_ids=[plan.query_id]))
+                done = self.stub.TerminateQueries(req)
+                print(f"terminated: {list(done.query_ids)}",
+                      file=self.out)
+            else:
+                resp = self.stub.ExecuteQuery(
+                    pb.CommandQuery(stmt_text=sql))
+                rows = [rec.struct_to_dict(s) for s in resp.result_set]
+                print(format_table(rows), file=self.out)
+        except grpc.RpcError as e:
+            print(f"server error: {e.details()}", file=self.out)
+
+    def _push_query(self, sql: str) -> None:
+        """Stream a push query until Ctrl-C (client.hs:117-132)."""
+        call = self.stub.ExecutePushQuery(
+            pb.CommandPushQuery(query_text=sql))
+        print("-- streaming; Ctrl-C to stop --", file=self.out)
+        try:
+            for s in call:
+                print(rec.struct_to_dict(s), file=self.out, flush=True)
+        except KeyboardInterrupt:
+            call.cancel()
+            print("\n-- query cancelled --", file=self.out)
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.CANCELLED:
+                print(f"server error: {e.details()}", file=self.out)
+
+    # ---- REPL -----------------------------------------------------------
+
+    def repl(self, input_lines: Iterable[str] | None = None) -> None:
+        """Run the shell. `input_lines` makes it scriptable for tests;
+        interactive mode uses readline-backed input()."""
+        interactive = input_lines is None
+        if interactive:
+            try:
+                import readline  # noqa: F401 — line editing/history
+            except ImportError:
+                pass
+            print(BANNER, file=self.out)
+        it = iter(input_lines) if input_lines is not None else None
+        buf: list[str] = []
+        while True:
+            prompt = CONT if buf else PROMPT
+            try:
+                if it is None:
+                    line = input(prompt)
+                else:
+                    line = next(it, None)
+                    if line is None:
+                        break
+            except EOFError:
+                break
+            except KeyboardInterrupt:
+                buf.clear()
+                print("", file=self.out)
+                continue
+            line = line.rstrip("\n")
+            if not buf and line.strip() in ("\\q", "quit", "exit"):
+                break
+            if not line.strip():
+                continue
+            buf.append(line)
+            if line.rstrip().endswith(";"):
+                sql = "\n".join(buf)
+                buf.clear()
+                self.execute(sql)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("hstream-tpu-client")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6570)
+    ap.add_argument("-e", "--execute", default=None,
+                    help="run one statement and exit")
+    args = ap.parse_args(argv)
+    client = Client(f"{args.host}:{args.port}")
+    try:
+        if args.execute:
+            client.execute(args.execute)
+        else:
+            client.repl()
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from hstream_tpu.client import main
+
+main()

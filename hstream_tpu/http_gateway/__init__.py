@@ -1,0 +1,304 @@
+"""REST gateway: HTTP façade over the gRPC API.
+
+Reference: hstream-http-server (~1,057 LoC Servant) — one resource
+module per entity (streams/queries/nodes/connectors/views/overview)
+proxying a gRPC client, plus Swagger
+(HStream/HTTP/Server/API.hs:33-54). Here a stdlib ThreadingHTTPServer
+routes the same resources onto the HStreamApi stub; /overview surfaces
+the server's stats holder via the GetStats RPC.
+
+Routes (JSON in/out):
+  GET    /overview                    cluster summary + per-stream stats
+  GET    /streams                     list
+  POST   /streams {"name": ...}       create
+  DELETE /streams/<name>              delete
+  POST   /streams/<name>/append {"records": [{...}]}   append JSON rows
+  GET    /queries | POST /queries {"sql": ...} | GET|DELETE /queries/<id>
+  POST   /queries/<id>/restart
+  GET    /views | GET /views/<name> (pull query) | DELETE /views/<name>
+  GET    /connectors | POST /connectors {"config": sql} | DELETE .../<id>
+  GET    /nodes
+  GET    /swagger.json
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import grpc
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+
+_STATUS = {
+    grpc.StatusCode.NOT_FOUND: 404,
+    grpc.StatusCode.ALREADY_EXISTS: 409,
+    grpc.StatusCode.INVALID_ARGUMENT: 400,
+    grpc.StatusCode.FAILED_PRECONDITION: 400,
+}
+
+
+class Gateway:
+    """Routes HTTP requests onto a single shared gRPC stub."""
+
+    def __init__(self, server_addr: str):
+        self.channel = grpc.insecure_channel(server_addr)
+        self.stub = HStreamApiStub(self.channel)
+
+    def close(self) -> None:
+        self.channel.close()
+
+    # ---- resource handlers -----------------------------------------------
+
+    def handle(self, method: str, path: str, body: dict | None
+               ) -> tuple[int, Any]:
+        stub = self.stub
+        try:
+            if path == "/overview" and method == "GET":
+                return 200, self._overview()
+            if path == "/swagger.json" and method == "GET":
+                return 200, SWAGGER
+            if path == "/streams" and method == "GET":
+                out = stub.ListStreams(pb.ListStreamsRequest())
+                return 200, [{"name": s.stream_name,
+                              "replication_factor": s.replication_factor}
+                             for s in out.streams]
+            if path == "/streams" and method == "POST":
+                name = (body or {}).get("name")
+                if not name:
+                    return 400, {"error": "body needs {\"name\": ...}"}
+                stub.CreateStream(pb.Stream(
+                    stream_name=name,
+                    replication_factor=(body or {}).get(
+                        "replication_factor", 1)))
+                return 201, {"name": name}
+            m = re.fullmatch(r"/streams/([^/]+)", path)
+            if m and method == "DELETE":
+                stub.DeleteStream(pb.DeleteStreamRequest(
+                    stream_name=m.group(1)))
+                return 200, {"deleted": m.group(1)}
+            m = re.fullmatch(r"/streams/([^/]+)/append", path)
+            if m and method == "POST":
+                rows = (body or {}).get("records")
+                if not isinstance(rows, list) or not rows:
+                    return 400, {"error":
+                                 "body needs {\"records\": [{...}]}"}
+                req = pb.AppendRequest(stream_name=m.group(1))
+                for row in rows:
+                    ts = row.pop("__time_ms", None) if isinstance(
+                        row, dict) else None
+                    req.records.append(
+                        rec.build_record(row, publish_time_ms=ts))
+                resp = stub.Append(req)
+                return 200, {"record_ids": [
+                    {"batch_id": r.batch_id, "batch_index": r.batch_index}
+                    for r in resp.record_ids]}
+
+            if path == "/queries" and method == "GET":
+                out = stub.ListQueries(pb.ListQueriesRequest())
+                return 200, [self._query_json(q) for q in out.queries]
+            if path == "/queries" and method == "POST":
+                sql = (body or {}).get("sql")
+                if not sql:
+                    return 400, {"error": "body needs {\"sql\": ...}"}
+                q = stub.CreateQuery(pb.CreateQueryRequest(
+                    query_text=sql, id=(body or {}).get("id", "")))
+                return 201, self._query_json(q)
+            m = re.fullmatch(r"/queries/([^/]+)", path)
+            if m and method == "GET":
+                q = stub.GetQuery(pb.GetQueryRequest(id=m.group(1)))
+                return 200, self._query_json(q)
+            if m and method == "DELETE":
+                stub.DeleteQuery(pb.DeleteQueryRequest(id=m.group(1)))
+                return 200, {"deleted": m.group(1)}
+            m = re.fullmatch(r"/queries/([^/]+)/restart", path)
+            if m and method == "POST":
+                stub.RestartQuery(pb.RestartQueryRequest(id=m.group(1)))
+                return 200, {"restarted": m.group(1)}
+
+            if path == "/views" and method == "GET":
+                out = stub.ListViews(pb.ListViewsRequest())
+                return 200, [{"name": v.view_id, "status": v.status,
+                              "sql": v.sql} for v in out.views]
+            m = re.fullmatch(r"/views/([^/]+)", path)
+            if m and method == "GET":
+                resp = stub.ExecuteQuery(pb.CommandQuery(
+                    stmt_text=f"SELECT * FROM {m.group(1)};"))
+                return 200, [rec.struct_to_dict(s)
+                             for s in resp.result_set]
+            if m and method == "DELETE":
+                stub.DeleteView(pb.DeleteViewRequest(view_id=m.group(1)))
+                return 200, {"deleted": m.group(1)}
+
+            if path == "/connectors" and method == "GET":
+                out = stub.ListConnectors(pb.ListConnectorsRequest())
+                return 200, [{"id": c.id, "status": c.status,
+                              "config": c.config}
+                             for c in out.connectors]
+            if path == "/connectors" and method == "POST":
+                cfg = (body or {}).get("config")
+                if not cfg:
+                    return 400, {"error": "body needs {\"config\": sql}"}
+                c = stub.CreateSinkConnector(
+                    pb.CreateSinkConnectorRequest(
+                        config=cfg, id=(body or {}).get("id", "")))
+                return 201, {"id": c.id, "status": c.status}
+            m = re.fullmatch(r"/connectors/([^/]+)", path)
+            if m and method == "DELETE":
+                stub.DeleteConnector(
+                    pb.DeleteConnectorRequest(id=m.group(1)))
+                return 200, {"deleted": m.group(1)}
+
+            if path == "/nodes" and method == "GET":
+                out = stub.ListNodes(pb.ListNodesRequest())
+                return 200, [{"id": n.id, "address": n.address,
+                              "port": n.port, "status": n.status,
+                              "roles": list(n.roles)}
+                             for n in out.nodes]
+            return 404, {"error": f"no route {method} {path}"}
+        except grpc.RpcError as e:
+            return (_STATUS.get(e.code(), 500),
+                    {"error": e.details() or str(e.code())})
+        except (TypeError, ValueError, AttributeError, KeyError) as e:
+            # malformed request bodies (wrong field types etc.) must get
+            # a JSON 400, not a dropped connection + server traceback
+            return 400, {"error": f"bad request: {e}"}
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    def _query_json(self, q) -> dict:
+        return {"id": q.id, "status": q.status,
+                "created_time_ms": q.created_time_ms,
+                "sql": q.query_text}
+
+    def _overview(self) -> dict:
+        """Cluster summary + the stats holder (reference Overview.hs —
+        which never exposed stats; this does, via GetStats)."""
+        stub = self.stub
+        streams = stub.ListStreams(pb.ListStreamsRequest()).streams
+        queries = stub.ListQueries(pb.ListQueriesRequest()).queries
+        views = stub.ListViews(pb.ListViewsRequest()).views
+        conns = stub.ListConnectors(pb.ListConnectorsRequest()).connectors
+        nodes = stub.ListNodes(pb.ListNodesRequest()).nodes
+        stats = stub.GetStats(pb.GetStatsRequest())
+        return {
+            "streams": len(streams),
+            "queries": len(queries),
+            "views": len(views),
+            "connectors": len(conns),
+            "nodes": [{"id": n.id, "status": n.status} for n in nodes],
+            "stats": [{
+                "stream": s.stream_name,
+                "counters": dict(s.counters),
+                "rates": {k: round(v, 3) for k, v in s.rates.items()},
+            } for s in stats.stats],
+        }
+
+
+def _make_handler(gw: Gateway):
+    class Handler(BaseHTTPRequestHandler):
+        def _run(self, method: str) -> None:
+            from urllib.parse import unquote, urlsplit
+
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                except ValueError:
+                    self._send(400, {"error": "invalid JSON body"})
+                    return
+            # strip query string, decode %-escapes in resource names
+            path = unquote(urlsplit(self.path).path)
+            code, payload = gw.handle(method, path.rstrip("/") or path,
+                                      body)
+            self._send(code, payload)
+
+        def _send(self, code: int, payload: Any) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            self._run("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._run("POST")
+
+        def do_DELETE(self):  # noqa: N802
+            self._run("DELETE")
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return Handler
+
+
+def serve_gateway(server_addr: str, host: str = "127.0.0.1",
+                  port: int = 6580) -> tuple[ThreadingHTTPServer, Gateway]:
+    """Start the gateway; returns (httpd, gateway). Caller owns
+    shutdown. Port 0 picks a free port (httpd.server_port)."""
+    gw = Gateway(server_addr)
+    httpd = ThreadingHTTPServer((host, port), _make_handler(gw))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, gw
+
+
+SWAGGER = {
+    "openapi": "3.0.0",
+    "info": {"title": "hstream-tpu HTTP gateway", "version": "1.0"},
+    "paths": {
+        "/overview": {"get": {"summary": "cluster summary + stats"}},
+        "/streams": {"get": {"summary": "list streams"},
+                     "post": {"summary": "create stream"}},
+        "/streams/{name}": {"delete": {"summary": "delete stream"}},
+        "/streams/{name}/append": {
+            "post": {"summary": "append JSON records"}},
+        "/queries": {"get": {"summary": "list queries"},
+                     "post": {"summary": "create push query"}},
+        "/queries/{id}": {"get": {"summary": "get query"},
+                          "delete": {"summary": "delete query"}},
+        "/queries/{id}/restart": {"post": {"summary": "restart query"}},
+        "/views": {"get": {"summary": "list views"}},
+        "/views/{name}": {"get": {"summary": "pull-query the view"},
+                          "delete": {"summary": "drop view"}},
+        "/connectors": {"get": {"summary": "list connectors"},
+                        "post": {"summary": "create sink connector"}},
+        "/connectors/{id}": {"delete": {"summary": "delete connector"}},
+        "/nodes": {"get": {"summary": "list server nodes"}},
+    },
+}
+
+
+def main(argv=None) -> None:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser("hstream-tpu-http-gateway")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=6580)
+    ap.add_argument("--server", default="127.0.0.1:6570",
+                    help="gRPC server address to proxy")
+    args = ap.parse_args(argv)
+    httpd, gw = serve_gateway(args.server, args.host, args.port)
+    print(f"http gateway on {args.host}:{httpd.server_port} -> "
+          f"{args.server}")
+    ev = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: ev.set())
+    signal.signal(signal.SIGTERM, lambda *a: ev.set())
+    ev.wait()
+    httpd.shutdown()
+    gw.close()
+
+
+if __name__ == "__main__":
+    main()

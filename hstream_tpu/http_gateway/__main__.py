@@ -1,0 +1,3 @@
+from hstream_tpu.http_gateway import main
+
+main()

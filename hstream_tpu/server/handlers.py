@@ -538,6 +538,42 @@ class HStreamApiServicer:
             raise ServerError(f"unknown node {request.id}")
         return self._node_pb()
 
+    @unary
+    def GetStats(self, request, context):
+        """Expose the stats holder (counters + time-series rates) — the
+        observability the reference keeps native-only
+        (common/clib/stats.h)."""
+        from hstream_tpu.stats import (
+            PER_STREAM_COUNTERS,
+            PER_STREAM_TIME_SERIES,
+        )
+
+        stats = self.ctx.stats
+        # counters are never pruned; report only streams that still
+        # exist so dashboards see the live topology
+        live = set(self.ctx.streams.find_streams())
+        per_stream: dict[str, pb.StreamStats] = {}
+
+        def ent(stream: str) -> pb.StreamStats:
+            e = per_stream.get(stream)
+            if e is None:
+                e = pb.StreamStats(stream_name=stream)
+                per_stream[stream] = e
+            return e
+
+        for metric in PER_STREAM_COUNTERS:
+            for stream, v in stats.stream_stat_getall(metric).items():
+                if stream in live:
+                    ent(stream).counters[metric] = v
+        for metric, _levels in PER_STREAM_TIME_SERIES:
+            for stream in list(per_stream):
+                ent(stream).rates[metric] = stats.time_series_peek_rate(
+                    metric, stream)
+        out = pb.GetStatsResponse()
+        for name in sorted(per_stream):
+            out.stats.append(per_stream[name])
+        return out
+
     # ---- plan execution (executeQueryHandler dispatch) ----------------------
 
     def _execute_plan(self, plan, sql: str) -> list[dict[str, Any]]:

@@ -137,6 +137,16 @@ class StatsHolder:
         levels = _TS_LEVELS[metric]
         return self._ts(metric, stream).rate(window_s or levels[-1])
 
+    def time_series_peek_rate(self, metric: str, stream: str,
+                              window_s: int | None = None) -> float:
+        """Read-only rate: 0.0 when no series exists — monitoring reads
+        must not allocate/retain state on the holder."""
+        with self._series_lock:
+            ts = self._series.get((metric, stream))
+        if ts is None:
+            return 0.0
+        return ts.rate(window_s or _TS_LEVELS[metric][-1])
+
     # ---- convenience for the append/read hot paths ----
     def note_append(self, stream: str, n_records: int, n_bytes: int) -> None:
         self.stream_stat_add("append_total", stream)
