@@ -18,6 +18,7 @@ from typing import Any, Iterable
 import grpc
 from google.protobuf import empty_pb2, struct_pb2
 
+from hstream_tpu.common import columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.errors import (
     HStreamError,
@@ -659,11 +660,79 @@ class HStreamApiServicer:
 
     # ---- task helpers -------------------------------------------------------
 
+    def _check_columns_against_stream(self,
+                                      plan: plans.SelectPlan) -> None:
+        """Unknown-column validation against SAMPLED records: the
+        reference's Validate.hs cannot see data, so an unknown column
+        silently becomes NULL and aggregates run on garbage; here query
+        creation reads the source stream's tail and rejects references
+        to columns absent from every sampled record. An empty stream
+        skips the check (nothing to know yet)."""
+        if plan.join is not None:
+            return  # two sources with qualified refs; not sampled
+        from hstream_tpu.engine.plan import AggregateNode
+        from hstream_tpu.store.api import LSN_INVALID
+
+        ctx = self.ctx
+        referenced = set(plan.schema_req.inferred)
+        if isinstance(plan.node, AggregateNode):
+            from hstream_tpu.engine.expr import Col as _Col
+
+            referenced |= {g.name for g in plan.node.group_keys
+                           if isinstance(g, _Col)}
+        if not referenced:
+            return
+        try:
+            logid = ctx.streams.get_logid(plan.source)
+            tail = ctx.store.tail_lsn(logid)
+        except HStreamError:
+            return
+        if tail == LSN_INVALID:
+            return
+        # best-effort sample: head + tail batches, so heterogeneous
+        # streams (different record shapes interleaved) are less likely
+        # to spuriously miss a real column; a column absent from EVERY
+        # sampled record is still rejected — better a creation-time
+        # error than aggregates silently running on NULLs
+        reader = ctx.store.new_reader()
+        reader.set_timeout(0)
+        lo = ctx.store.trim_point(logid) + 1
+        reader.start_reading(logid, lo, min(lo + 2, tail))
+        head = reader.read(16)
+        reader.stop_reading(logid)
+        reader.start_reading(logid, max(tail - 4, lo), tail)
+        fields: set[str] = set()
+        sampled = False
+        for item in head + reader.read(64):
+            if not isinstance(item, DataBatch):
+                continue
+            for payload in item.payloads:
+                r = rec.parse_record(payload)
+                if (r.header.flag == rec.pb.RECORD_FLAG_RAW
+                        and columnar.is_columnar(r.payload)):
+                    try:
+                        _, cols = columnar.decode_columnar(r.payload)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    fields |= set(cols)
+                    sampled = True
+                else:
+                    d = rec.record_to_dict(r)
+                    if d is not None:
+                        fields |= set(d)
+                        sampled = True
+        missing = referenced - fields
+        if sampled and missing:
+            raise ServerError(
+                f"unknown column(s) {sorted(missing)}: not present in "
+                f"recent records of stream {plan.source!r}")
+
     def _launch_query(self, plan: plans.SelectPlan, sql: str, qtype: str,
                       *, sink_stream: str,
                       sink_type: StreamType = StreamType.STREAM,
                       query_id: str | None = None) -> QueryInfo:
         ctx = self.ctx
+        self._check_columns_against_stream(plan)
         query_id = query_id or f"q{gen_unique()}"
         info = QueryInfo(query_id=query_id, sql=sql,
                          created_time_ms=now_ms(), query_type=qtype,
@@ -692,6 +761,7 @@ class HStreamApiServicer:
     def _create_view(self, plan: plans.CreateViewPlan,
                      sql: str) -> QueryInfo:
         ctx = self.ctx
+        self._check_columns_against_stream(plan.select)
         query_id = f"view-{plan.view}"
         info = QueryInfo(query_id=query_id, sql=sql,
                          created_time_ms=now_ms(), query_type=QUERY_VIEW,

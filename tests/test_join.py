@@ -91,7 +91,10 @@ def test_join_timestamp_is_max_of_pair():
 
 
 def test_join_rejects_bad_condition():
-    with pytest.raises(SQLCodegenError):
+    # caught at validation now (refine._validate_join), before codegen
+    from hstream_tpu.common.errors import SQLError
+
+    with pytest.raises(SQLError):
         plan = stream_codegen(
             "SELECT s1.x FROM s1 INNER JOIN s2 "
             "WITHIN (INTERVAL 10 SECOND) ON s1.k = s1.j EMIT CHANGES;")
