@@ -724,8 +724,13 @@ class QueryExecutor:
                  win_start_abs: int | None) -> dict[str, Any] | None:
         row = self._decode_key(kid)
         for name, arr in outs.items():
-            v = float(arr[idx])
             spec = next(a for a in self.spec.aggs if a.out_name == name)
+            if spec.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                vals = np.asarray(arr[idx])
+                row[name] = [float(x) for x in vals
+                             if np.isfinite(x)]
+                continue
+            v = float(arr[idx])
             if spec.kind in (AggKind.COUNT_ALL, AggKind.COUNT,
                              AggKind.APPROX_COUNT_DISTINCT):
                 v = int(round(v))

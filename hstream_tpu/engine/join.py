@@ -136,15 +136,10 @@ class _SideStore:
             del self.by_key[key]
 
 
-class JoinExecutor:
-    """Executes `SELECT ... FROM l [INNER|LEFT] JOIN r WITHIN(...) ON ...`.
-
-    API: process(rows, ts_ms, stream=<source name or alias>) — the task
-    runtime feeds records from BOTH streams through the one executor,
-    tagging each batch with its origin (the reference merges both
-    sources into one task, Codegen.hs:250-266). Joined rows feed the
-    inner (aggregate/stateless) executor built over the joined schema.
-    """
+class _JoinBase:
+    """Shared plumbing of both join executors: alias/side routing, ON
+    key split, joined-row construction, and the inner (downstream)
+    executor lifecycle."""
 
     def __init__(self, plan, *, initial_keys: int = 1024,
                  batch_capacity: int = 4096):
@@ -153,15 +148,13 @@ class JoinExecutor:
         self.left_name = plan.source
         self.right_name = join.right.name
         if self.right_name == self.left_name:
-            raise SQLCodegenError("self-join needs distinct aliases")
+            raise SQLCodegenError("self-join needs distinct streams")
         self.join_type = join.join_type
         if self.join_type not in ("INNER", "JOIN"):
             raise SQLCodegenError(
                 f"{self.join_type} JOIN not supported (INNER only, like "
                 "the reference's RJoinInner path)")
-        self.within = join.within.ms
         self._aliases = {self.left_name: "l", self.right_name: "r"}
-        # aliases (AS x) route process(stream=) too
         left_al = {self.left_name}
         right_al = {self.right_name}
         la = getattr(plan, "source_alias", None)
@@ -173,23 +166,22 @@ class JoinExecutor:
             right_al.add(join.right.alias)
         self.left_keys, self.right_keys = split_on_condition(
             join.on, left_al, right_al)
-
-        # retention: a future in-grace record can probe back `within`;
-        # grace defaults to the downstream window's (or the SQL default)
-        node = plan.node
-        grace = DEFAULT_GRACE_MS
-        if isinstance(node, AggregateNode) and node.window is not None:
-            grace = node.window.grace_ms
-        self.retention_ms = self.within + grace
-
-        self._stores = {"l": _SideStore(), "r": _SideStore()}
-        self.watermark: int = -1
         self._inner = None
         self._inner_plan = replace(plan, join=None)
         self._initial_keys = initial_keys
         self._batch_capacity = batch_capacity
 
-    # ---- joined-row construction -------------------------------------------
+    def _side_of(self, stream: str | None) -> str:
+        if stream is None:
+            raise SQLCodegenError(
+                f"{type(self).__name__}.process requires stream=<name or "
+                "alias>: a join consumes two streams and must know each "
+                "batch's origin")
+        side = self._aliases.get(stream)
+        if side is None:
+            raise SQLCodegenError(
+                f"stream {stream!r} is not part of this join")
+        return side
 
     def _joined_row(self, lrow: Mapping[str, Any],
                     rrow: Mapping[str, Any]) -> dict[str, Any]:
@@ -215,20 +207,114 @@ class JoinExecutor:
             return None
         return canon_key(vals)
 
+    def _inner_process(self, joined, jts):
+        if self._inner is None:
+            from hstream_tpu.sql.codegen import make_executor
+
+            self._inner = make_executor(
+                self._inner_plan, sample_rows=joined,
+                initial_keys=self._initial_keys,
+                batch_capacity=self._batch_capacity)
+        return self._inner.process(joined, jts)
+
+    # ---- drains (API parity with QueryExecutor) ----------------------------
+
+    def peek(self) -> list[dict[str, Any]]:
+        return [] if self._inner is None else self._inner.peek()
+
+    def close_due_windows(self) -> list[dict[str, Any]]:
+        if self._inner is None or not hasattr(self._inner,
+                                              "close_due_windows"):
+            return []
+        return self._inner.close_due_windows()
+
+    def block_until_ready(self) -> None:
+        if self._inner is not None and hasattr(self._inner,
+                                               "block_until_ready"):
+            self._inner.block_until_ready()
+
+
+class TableJoinExecutor(_JoinBase):
+    """Executes `SELECT ... FROM l INNER JOIN TABLE(r) ON ...`.
+
+    Reference semantics (Stream.hs:302-344, joinStreamTable): the right
+    side is a TABLE — the latest row per join key of a changelog stream.
+    Stream records probe the table and emit one joined row when the key
+    is present; table records only update state (no retroactive
+    emission). State is bounded by the table's key cardinality.
+    """
+
+    def __init__(self, plan, *, initial_keys: int = 1024,
+                 batch_capacity: int = 4096):
+        super().__init__(plan, initial_keys=initial_keys,
+                         batch_capacity=batch_capacity)
+        # key -> (ts of latest row, row): the keyed last-value table
+        self.table: dict[tuple, tuple[int, dict]] = {}
+
+    def process(self, rows: Sequence[Mapping[str, Any]],
+                ts_ms: Sequence[int], stream: str | None = None
+                ) -> list[dict[str, Any]]:
+        side = self._side_of(stream)
+        if side == "r":
+            for row, ts in zip(rows, ts_ms):
+                key = self._key(self.right_keys, row)
+                if key is None:
+                    continue
+                ts = int(ts)
+                cur = self.table.get(key)
+                if cur is None or ts >= cur[0]:
+                    self.table[key] = (ts, dict(row))
+            return []
+        joined: list[dict[str, Any]] = []
+        jts: list[int] = []
+        for row, ts in zip(rows, ts_ms):
+            key = self._key(self.left_keys, row)
+            if key is None:
+                continue
+            ent = self.table.get(key)
+            if ent is None:
+                continue  # INNER: stream rows without a table row drop
+            joined.append(self._joined_row(row, ent[1]))
+            jts.append(int(ts))
+        if not joined:
+            return []
+        return self._inner_process(joined, jts)
+
+
+class JoinExecutor(_JoinBase):
+    """Executes `SELECT ... FROM l [INNER|LEFT] JOIN r WITHIN(...) ON ...`.
+
+    API: process(rows, ts_ms, stream=<source name or alias>) — the task
+    runtime feeds records from BOTH streams through the one executor,
+    tagging each batch with its origin (the reference merges both
+    sources into one task, Codegen.hs:250-266). Joined rows feed the
+    inner (aggregate/stateless) executor built over the joined schema.
+    """
+
+    def __init__(self, plan, *, initial_keys: int = 1024,
+                 batch_capacity: int = 4096):
+        super().__init__(plan, initial_keys=initial_keys,
+                         batch_capacity=batch_capacity)
+        join = plan.join
+        self.within = join.within.ms
+
+        # retention: a future in-grace record can probe back `within`;
+        # grace defaults to the downstream window's (or the SQL default)
+        node = plan.node
+        grace = DEFAULT_GRACE_MS
+        if isinstance(node, AggregateNode) and node.window is not None:
+            grace = node.window.grace_ms
+        self.retention_ms = self.within + grace
+
+        self._stores = {"l": _SideStore(), "r": _SideStore()}
+        self.watermark: int = -1
+
     # ---- ingest ------------------------------------------------------------
 
     def process(self, rows: Sequence[Mapping[str, Any]],
                 ts_ms: Sequence[int], stream: str | None = None
                 ) -> list[dict[str, Any]]:
-        if stream is None:
-            raise SQLCodegenError(
-                "JoinExecutor.process requires stream=<name or alias>: a "
-                "join consumes two streams and must know each batch's "
-                "origin")
-        side = self._aliases.get(stream)
-        if side is None:
-            raise SQLCodegenError(f"stream {stream!r} is not part of this "
-                                  f"join")
+        side = self._side_of(stream)
         mine = self._stores[side]
         other = self._stores["r" if side == "l" else "l"]
         my_keys = self.left_keys if side == "l" else self.right_keys
@@ -259,28 +345,3 @@ class JoinExecutor:
             return []
         return self._inner_process(joined, jts)
 
-    def _inner_process(self, joined, jts):
-        if self._inner is None:
-            from hstream_tpu.sql.codegen import make_executor
-
-            self._inner = make_executor(
-                self._inner_plan, sample_rows=joined,
-                initial_keys=self._initial_keys,
-                batch_capacity=self._batch_capacity)
-        return self._inner.process(joined, jts)
-
-    # ---- drains (API parity with QueryExecutor) ----------------------------
-
-    def peek(self) -> list[dict[str, Any]]:
-        return [] if self._inner is None else self._inner.peek()
-
-    def close_due_windows(self) -> list[dict[str, Any]]:
-        if self._inner is None or not hasattr(self._inner,
-                                              "close_due_windows"):
-            return []
-        return self._inner.close_due_windows()
-
-    def block_until_ready(self) -> None:
-        if self._inner is not None and hasattr(self._inner,
-                                               "block_until_ready"):
-            self._inner.block_until_ready()

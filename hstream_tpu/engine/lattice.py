@@ -78,6 +78,14 @@ def _plane_name(i: int, agg: AggSpec) -> str:
     return f"a{i}_{agg.kind.value}"
 
 
+_TOPK_KINDS = (AggKind.TOPK, AggKind.TOPK_DISTINCT)
+
+
+def agg_width(agg: AggSpec) -> int:
+    """Values per key this aggregate emits (k for TOPK, else 1)."""
+    return (agg.k or 10) if agg.kind in _TOPK_KINDS else 1
+
+
 def init_state(spec: LatticeSpec) -> dict[str, jnp.ndarray]:
     K, W = spec.n_keys, spec.n_slots
     state: dict[str, jnp.ndarray] = {
@@ -102,6 +110,11 @@ def init_state(spec: LatticeSpec) -> dict[str, jnp.ndarray]:
             state[name] = jnp.zeros((K, W, spec.hll.m), jnp.int8)
         elif agg.kind == AggKind.APPROX_QUANTILE:
             state[name] = jnp.zeros((K, W, spec.qcfg.n_bins), jnp.int32)
+        elif agg.kind in _TOPK_KINDS:
+            # fixed-k plane of the current top values, kept sorted
+            # descending; merging = concat + re-sort (see step)
+            state[name] = jnp.full((K, W, agg_width(agg)), NEG_INF,
+                                   jnp.float32)
         else:
             raise NotImplementedError(f"agg {agg.kind}")
     return state
@@ -222,11 +235,62 @@ def build_step_fn(spec: LatticeSpec,
                 b_rep = jnp.repeat(quantile_bin(v, spec.qcfg), n_per)
                 out[name] = state[name].at[flat_k, flat_s, b_rep].add(
                     iok.astype(jnp.int32), mode="drop")
+            elif agg.kind in _TOPK_KINDS:
+                out[name] = _topk_step(
+                    state[name], agg, spec,
+                    jnp.where(iok, v_rep.astype(jnp.float32), NEG_INF),
+                    flat_k, flat_s, iok)
             else:
                 raise NotImplementedError(agg.kind)
         return out
 
     return step
+
+
+def _topk_step(plane, agg: AggSpec, spec: LatticeSpec, vals, flat_k,
+               flat_s, ok):
+    """Fold one batch into a TOPK plane [K, W, k].
+
+    Batch-local top-k per (key, slot) via ONE lexicographic device sort
+    (segment id asc, value desc) + segmented ranking, scattered into a
+    scratch plane; then the scratch merges with the stored plane by
+    concat + re-sort along the k axis — top-k of a union is a
+    commutative monoid, so the fold order never matters."""
+    K, W = spec.n_keys, spec.n_slots
+    kk = agg_width(agg)
+    seg = jnp.where(ok, flat_k * W + flat_s, K * W).astype(jnp.int32)
+    sseg, sneg = jax.lax.sort((seg, -vals), num_keys=2)
+    sval = -sneg
+    idx = jnp.arange(sseg.shape[0], dtype=jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sseg[1:] != sseg[:-1]])
+    if agg.kind == AggKind.TOPK_DISTINCT:
+        # count only the first record of each (segment, value) run
+        newval = first | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sval[1:] != sval[:-1]])
+        c = jnp.cumsum(newval.astype(jnp.int32))
+        base = jax.lax.cummax(
+            jnp.where(first, c - newval.astype(jnp.int32), 0))
+        rank = jnp.where(newval, c - 1 - base, kk)
+    else:
+        seg_start = jax.lax.cummax(jnp.where(first, idx, 0))
+        rank = idx - seg_start
+    keep = (rank < kk) & (sseg < K * W) & (sval > NEG_INF)
+    kf = jnp.where(keep, sseg // W, K)
+    sf = jnp.where(keep, sseg % W, 0)
+    rf = jnp.where(keep, rank, 0)
+    scratch = jnp.full((K, W, kk), NEG_INF, jnp.float32)
+    scratch = scratch.at[kf, sf, rf].set(
+        jnp.where(keep, sval, NEG_INF), mode="drop")
+    comb = jnp.concatenate([plane, scratch], axis=-1)
+    comb = -jnp.sort(-comb, axis=-1)
+    if agg.kind == AggKind.TOPK_DISTINCT:
+        dup = jnp.concatenate(
+            [jnp.zeros(comb.shape[:-1] + (1,), jnp.bool_),
+             comb[..., 1:] == comb[..., :-1]], axis=-1)
+        comb = jnp.where(dup, NEG_INF, comb)
+        comb = -jnp.sort(-comb, axis=-1)
+    return comb[..., :kk]
 
 
 # ---- packed batch transport ------------------------------------------------
@@ -355,6 +419,8 @@ def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
         elif agg.kind == AggKind.MAX:
             outs[agg.out_name] = jnp.where(
                 state_col["count"] > 0, state_col[name], 0.0)
+        elif agg.kind in _TOPK_KINDS:
+            outs[agg.out_name] = state_col[name]  # [K, k] passthrough
         else:
             outs[agg.out_name] = state_col[name].astype(jnp.float32)
     return outs
@@ -362,24 +428,40 @@ def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
 
 def pack_extract_rows(spec: LatticeSpec, count, win_start, outs):
     """Stack (count, win_start, finalized agg outputs) into ONE int32
-    buffer [2 + n_aggs, K] (float outputs bitcast) so the host pays a
-    single device->host fetch per drain instead of one per plane — host
-    sync count, not bytes, dominates drain cost."""
+    buffer [2 + sum(widths), K] (float outputs bitcast) so the host pays
+    a single device->host fetch per drain instead of one per plane —
+    host sync count, not bytes, dominates drain cost. A width-k agg
+    (TOPK) contributes k rows."""
     k = count.shape[0]
     rows = [count.astype(jnp.int32),
             jnp.broadcast_to(jnp.asarray(win_start, jnp.int32), (k,))]
     for agg in spec.aggs:
-        rows.append(jax.lax.bitcast_convert_type(
-            outs[agg.out_name].astype(jnp.float32), jnp.int32))
+        o = outs[agg.out_name].astype(jnp.float32)
+        if agg.kind in _TOPK_KINDS:
+            for j in range(agg_width(agg)):
+                rows.append(jax.lax.bitcast_convert_type(o[:, j],
+                                                         jnp.int32))
+        else:
+            rows.append(jax.lax.bitcast_convert_type(o, jnp.int32))
     return jnp.stack(rows)
 
 
 def unpack_extract_rows(spec: LatticeSpec, packed: np.ndarray):
-    """(count [K], win_start [K], {name: [K] f32}) from pack_extract_rows."""
+    """(count [K], win_start [K], {name: [K] or [K, width] f32}) from
+    pack_extract_rows."""
     count = packed[0]
     win_start = packed[1]
-    outs = {agg.out_name: packed[2 + i].view(np.float32)
-            for i, agg in enumerate(spec.aggs)}
+    outs = {}
+    row = 2
+    for agg in spec.aggs:
+        w = agg_width(agg)
+        if agg.kind in _TOPK_KINDS:
+            outs[agg.out_name] = np.stack(
+                [packed[row + j].view(np.float32) for j in range(w)],
+                axis=1)
+        else:
+            outs[agg.out_name] = packed[row].view(np.float32)
+        row += w
     return count, win_start, outs
 
 
@@ -419,28 +501,43 @@ def build_reset_slot(spec: LatticeSpec):
 def init_value(agg: AggSpec):
     if agg.kind == AggKind.MIN:
         return POS_INF
-    if agg.kind == AggKind.MAX:
+    if agg.kind in (AggKind.MAX,) + _TOPK_KINDS:
         return NEG_INF
     return 0
 
 
 def pack_touched_rows(spec: LatticeSpec, n, kidx, win_start, outs,
                       max_out: int):
-    """ONE int32 buffer [3 + n_aggs, max_out]: row0 col0 = n, row1 = key
-    ids, row2 = win starts, rows 3+ = bitcast float agg outputs."""
+    """ONE int32 buffer [3 + sum(widths), max_out]: row0 col0 = n,
+    row1 = key ids, row2 = win starts, rows 3+ = bitcast float agg
+    outputs (width-k aggs contribute k rows)."""
     rows = [jnp.zeros((max_out,), jnp.int32).at[0].set(n),
             kidx.astype(jnp.int32), win_start.astype(jnp.int32)]
     for agg in spec.aggs:
-        rows.append(jax.lax.bitcast_convert_type(
-            outs[agg.out_name].astype(jnp.float32), jnp.int32))
+        o = outs[agg.out_name].astype(jnp.float32)
+        if agg.kind in _TOPK_KINDS:
+            for j in range(agg_width(agg)):
+                rows.append(jax.lax.bitcast_convert_type(o[:, j],
+                                                         jnp.int32))
+        else:
+            rows.append(jax.lax.bitcast_convert_type(o, jnp.int32))
     return jnp.stack(rows)
 
 
 def unpack_touched_rows(spec: LatticeSpec, packed: np.ndarray):
-    """(n, kidx [n], win_start [n], {name: [n] f32})."""
+    """(n, kidx [n], win_start [n], {name: [n] or [n, width] f32})."""
     n = int(packed[0, 0])
-    outs = {agg.out_name: packed[3 + i, :n].view(np.float32)
-            for i, agg in enumerate(spec.aggs)}
+    outs = {}
+    row = 3
+    for agg in spec.aggs:
+        w = agg_width(agg)
+        if agg.kind in _TOPK_KINDS:
+            outs[agg.out_name] = np.stack(
+                [packed[row + j, :n].view(np.float32) for j in range(w)],
+                axis=1)
+        else:
+            outs[agg.out_name] = packed[row, :n].view(np.float32)
+        row += w
     return n, packed[1, :n], packed[2, :n], outs
 
 
@@ -488,6 +585,10 @@ def plane_merge_kinds(spec: LatticeSpec) -> dict[str, str]:
             kinds[name] = "min"
         elif agg.kind in (AggKind.MAX, AggKind.APPROX_COUNT_DISTINCT):
             kinds[name] = "max"
+        elif agg.kind in _TOPK_KINDS:
+            # NOT elementwise: merging two top-k planes needs
+            # concat+sort; sharded execution rejects these specs
+            kinds[name] = "topk"
         else:
             kinds[name] = "sum"
             if agg.kind == AggKind.AVG:
@@ -586,7 +687,7 @@ def grow_keys(state: dict[str, jnp.ndarray], spec: LatticeSpec,
         pad_width = [(0, extra)] + [(0, 0)] * (v.ndim - 1)
         if k.endswith("_min"):
             out[k] = jnp.pad(v, pad_width, constant_values=np.float32(np.inf))
-        elif k.endswith("_max"):
+        elif k.endswith(("_max", "_topk", "_topk_distinct")):
             out[k] = jnp.pad(v, pad_width, constant_values=np.float32(-np.inf))
         else:
             out[k] = jnp.pad(v, pad_width)

@@ -26,7 +26,8 @@ class AggKind(enum.Enum):
     MAX = "max"
     APPROX_COUNT_DISTINCT = "approx_count_distinct"  # HLL sketch
     APPROX_QUANTILE = "approx_quantile"              # log-binned histogram
-    TOPK = "topk"                  # declared in reference AST; max-k values
+    TOPK = "topk"                  # top-k values per group/window
+    TOPK_DISTINCT = "topk_distinct"
 
 
 @dataclass(frozen=True)

@@ -124,6 +124,8 @@ def _acc_init(agg: AggSpec, hll: HLLConfig, qcfg: QuantileConfig):
         return np.zeros(hll.m, dtype=np.int8)
     if agg.kind == AggKind.APPROX_QUANTILE:
         return np.zeros(qcfg.n_bins, dtype=np.int64)
+    if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+        return []  # descending value list, trimmed to k
     raise SQLCodegenError(f"session agg {agg.kind} unsupported")
 
 
@@ -140,6 +142,10 @@ def _acc_merge(agg: AggSpec, a, b):
         return np.maximum(a, b)
     if agg.kind == AggKind.APPROX_QUANTILE:
         return a + b
+    if agg.kind == AggKind.TOPK:
+        return sorted(a + b, reverse=True)[: agg.k or 10]
+    if agg.kind == AggKind.TOPK_DISTINCT:
+        return sorted(set(a) | set(b), reverse=True)[: agg.k or 10]
     raise SQLCodegenError(f"session agg {agg.kind} unsupported")
 
 
@@ -207,6 +213,8 @@ class SessionExecutor:
             acc = acc.copy()
             acc[b] += 1
             return acc
+        if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+            return _acc_merge(agg, acc, [float(v)])
         raise SQLCodegenError(f"session agg {agg.kind} unsupported")
 
     def process(self, rows: Sequence[Mapping[str, Any]],
@@ -305,6 +313,8 @@ class SessionExecutor:
             return int(round(hll_estimate_np(acc, self.hll)))
         if agg.kind == AggKind.APPROX_QUANTILE:
             return quantile_estimate_np(acc, agg.quantile or 0.5, self.qcfg)
+        if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+            return list(acc)
         return acc
 
     def _emit_row(self, key: tuple, s: _Session) -> dict[str, Any] | None:

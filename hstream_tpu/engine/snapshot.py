@@ -95,7 +95,7 @@ def capture_executor(ex, extra: dict | None = None
     are shallow-copied or encoded. Heavy work (device->host sync,
     npz/zlib packing) happens in serialize_capture() WITHOUT the lock."""
     from hstream_tpu.engine.executor import QueryExecutor
-    from hstream_tpu.engine.join import JoinExecutor
+    from hstream_tpu.engine.join import JoinExecutor, TableJoinExecutor
     from hstream_tpu.engine.session import SessionExecutor
     from hstream_tpu.engine.stateless import StatelessExecutor
 
@@ -103,6 +103,8 @@ def capture_executor(ex, extra: dict | None = None
         meta, arrays = _lattice_state(ex)
     elif isinstance(ex, SessionExecutor):
         meta, arrays = _session_state(ex), {}
+    elif isinstance(ex, TableJoinExecutor):
+        meta, arrays = _table_join_state(ex)
     elif isinstance(ex, JoinExecutor):
         meta, arrays = _join_state(ex)
     elif isinstance(ex, StatelessExecutor):
@@ -137,7 +139,11 @@ def restore_executor(plan, blob: bytes, *, initial_keys: int = 1024,
     it back — see _scatter_state)."""
     meta, arrays = _unpack(blob)
     kind = meta["kind"]
-    if kind == "join":
+    if kind == "tablejoin":
+        ex = _restore_table_join(plan, meta, arrays,
+                                 initial_keys=initial_keys,
+                                 batch_capacity=batch_capacity)
+    elif kind == "join":
         ex = _restore_join(plan, meta, arrays,
                            initial_keys=initial_keys,
                            batch_capacity=batch_capacity)
@@ -219,6 +225,12 @@ def _restore_lattice(node, meta, arrays, *, batch_capacity: int = 4096,
 
     schema = Schema(tuple((n, ColumnType(t)) for n, t in meta["schema"]))
     cap = meta.get("batch_capacity", batch_capacity)
+    if mesh is not None:
+        from hstream_tpu.engine.plan import AggKind
+
+        if any(a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)
+               for a in node.aggs):
+            mesh = None  # no elementwise shard merge for TOPK planes
     if mesh is not None:
         from hstream_tpu.parallel import ShardedQueryExecutor
 
@@ -303,6 +315,41 @@ def _restore_session(node, meta):
         ex.sessions[key] = [
             _Session(start=s["a"], end=s["b"], accs=_dec(s["acc"]))
             for s in ent["s"]]
+    return ex
+
+
+# ---- stream-table join ------------------------------------------------------
+
+
+def _table_join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
+    meta = {
+        "kind": "tablejoin",
+        "batch_capacity": ex._batch_capacity,
+        "table": [{"k": _enc(key), "t": ts, "r": row}
+                  for key, (ts, row) in ex.table.items()],
+    }
+    arrays = {}
+    if ex._inner is not None:
+        arrays["i/blob"] = np.frombuffer(snapshot_executor(ex._inner),
+                                         dtype=np.uint8)
+    return meta, arrays
+
+
+def _restore_table_join(plan, meta, arrays, *, initial_keys: int,
+                        batch_capacity: int):
+    from hstream_tpu.engine.join import TableJoinExecutor
+
+    ex = TableJoinExecutor(plan, initial_keys=initial_keys,
+                           batch_capacity=meta.get("batch_capacity",
+                                                   batch_capacity))
+    for ent in meta["table"]:
+        ex.table[tuple(_dec(ent["k"]))] = (int(ent["t"]), ent["r"])
+    if "i/blob" in arrays:
+        inner, _ = restore_executor(ex._inner_plan,
+                                    arrays["i/blob"].tobytes(),
+                                    initial_keys=initial_keys,
+                                    batch_capacity=batch_capacity)
+        ex._inner = inner
     return ex
 
 

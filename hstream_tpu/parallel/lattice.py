@@ -96,6 +96,12 @@ class ShardedLattice:
         self._local_step = build_step_fn(self.local_spec, agg_inputs,
                                          filter_fn)
         self._merge_kinds = plane_merge_kinds(spec)
+        bad = sorted(k for k, v in self._merge_kinds.items()
+                     if v not in _MERGE)
+        if bad:
+            raise ValueError(
+                f"plane(s) {bad} have no elementwise merge (TOPK): "
+                "sharded execution is not supported for this query")
         self._state_specs = None  # built lazily from init_state's tree
         self._build()
 

@@ -97,8 +97,9 @@ class StreamRef:
 class JoinClause:
     join_type: str           # INNER / LEFT / OUTER
     right: StreamRef
-    within: Interval
+    within: Interval | None  # None = stream-table join (JOIN TABLE(x))
     on: Expr
+    table: bool = False      # right side is a keyed last-value table
 
 
 @dataclass(frozen=True)

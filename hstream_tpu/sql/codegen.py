@@ -46,6 +46,7 @@ _AGG_KIND = {
     ast.SetFuncKind.APPROX_COUNT_DISTINCT: AggKind.APPROX_COUNT_DISTINCT,
     ast.SetFuncKind.APPROX_QUANTILE: AggKind.APPROX_QUANTILE,
     ast.SetFuncKind.TOPK: AggKind.TOPK,
+    ast.SetFuncKind.TOPKDISTINCT: AggKind.TOPK_DISTINCT,
 }
 
 _STRINGY_OPS = {"TO_UPPER", "TO_LOWER", "TRIM", "LTRIM", "RTRIM",
@@ -117,7 +118,7 @@ class _AggCollector:
 
     def intern(self, sf: ast.SetFunc) -> Col:
         kind = _AGG_KIND.get(sf.kind)
-        if kind is None or kind == AggKind.TOPK:
+        if kind is None:
             raise SQLCodegenError(f"aggregate {sf.kind.value} not supported")
         key = (kind, sf.arg, sf.arg2)
         name = self._by_key.get(key)
@@ -130,6 +131,8 @@ class _AggCollector:
             quantile = k = None
             if kind == AggKind.APPROX_QUANTILE:
                 quantile = float(sf.arg2)
+            if kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                k = int(sf.arg2)
             self.specs.append(AggSpec(kind=kind, out_name=name,
                                       input=sf.arg, quantile=quantile,
                                       k=k))
@@ -371,8 +374,12 @@ def explain_text(plan: plans.Plan) -> str:
 
         walk(node, 0)
         if plan.join is not None:
-            lines.insert(0, f"JOIN {plan.join.right.name} "
-                            f"WITHIN {plan.join.within.ms}ms")
+            if getattr(plan.join, "table", False):
+                lines.insert(0, f"JOIN TABLE({plan.join.right.name}) "
+                                "[keyed last-value]")
+            else:
+                lines.insert(0, f"JOIN {plan.join.right.name} "
+                                f"WITHIN {plan.join.within.ms}ms")
         return "\n".join(lines)
     if isinstance(plan, plans.CreateBySelectPlan):
         return (f"CREATE STREAM {plan.stream} AS\n"
@@ -414,12 +421,14 @@ def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
         if mesh is not None:
             raise SQLCodegenError(
                 "sharded execution of JOIN plans is not supported yet")
-        from hstream_tpu.engine.join import JoinExecutor
+        from hstream_tpu.engine.join import JoinExecutor, TableJoinExecutor
 
         # schema inference for the inner executor uses the first JOINED
         # batch (caller sample rows are single-stream shaped)
-        return JoinExecutor(plan, initial_keys=initial_keys,
-                            batch_capacity=batch_capacity)
+        cls = TableJoinExecutor if getattr(plan.join, "table", False) \
+            else JoinExecutor
+        return cls(plan, initial_keys=initial_keys,
+                   batch_capacity=batch_capacity)
     node = plan.node
     if isinstance(node, AggregateNode):
         schema = bind_schema(plan, sample_rows)
@@ -428,6 +437,10 @@ def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
 
             return SessionExecutor(node, schema,
                                    emit_changes=plan.emit_changes)
+        if mesh is not None and any(
+                a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)
+                for a in node.aggs):
+            mesh = None  # TOPK planes have no elementwise shard merge
         if mesh is not None:
             from hstream_tpu.parallel import ShardedQueryExecutor
 

@@ -96,6 +96,12 @@ class Parser:
         t = self.peek()
         return t.kind == "SYM" and t.text == s
 
+    def peek2_sym(self, s: str) -> bool:
+        """The token AFTER the current one is the symbol `s` (lookahead
+        to disambiguate JOIN TABLE( from a stream named table)."""
+        t = self.peek(1)
+        return t.kind == "SYM" and t.text == s
+
     def eat_sym(self, s: str) -> Token:
         if not self.at_sym(s):
             self.err(f"expected {s!r}")
@@ -343,6 +349,21 @@ class Parser:
         if self.at_kw("INNER", "LEFT", "OUTER"):
             jt = self.next().upper
         self.eat_kw("JOIN")
+        # JOIN TABLE(s): the right side is a keyed last-value TABLE of
+        # the stream (reference stream-table join, Stream.hs:302-344);
+        # no WITHIN — table lookups are not time-bounded
+        if self.at_kw("TABLE") and self.peek2_sym("("):
+            self.next()
+            self.eat_sym("(")
+            right = self.parse_stream_ref()
+            self.eat_sym(")")
+            alias = None
+            if self.try_kw("AS"):
+                alias = self.ident("alias")
+                right = ast.StreamRef(right.name, alias)
+            self.eat_kw("ON")
+            on = self.parse_cond()
+            return ast.JoinClause(jt, right, None, on, table=True)
         right = self.parse_stream_ref()
         self.eat_kw("WITHIN")
         self.eat_sym("(")

@@ -126,7 +126,8 @@ def _validate_group_consistency(sel: ast.Select) -> None:
 
 def _validate_join(sel: ast.Select) -> None:
     join = sel.join
-    _validate_interval(join.within, "JOIN WITHIN")
+    if not join.table:
+        _validate_interval(join.within, "JOIN WITHIN")
     left_names = {sel.source.name, sel.source.alias} - {None}
     right_names = {join.right.name, join.right.alias} - {None}
     if join.right.name == sel.source.name:
