@@ -1,0 +1,125 @@
+"""Operator admin CLI (the reference's hstore-admin analogue).
+
+Reference: a Thrift admin CLI with status/nodes-config/logs/
+check-impact/maintenance/sql subcommands
+(hstream-store/admin/app/cli.hs:56-69). Here the ops surface rides the
+gRPC API: cluster status tables, per-entity listings, live stats, and
+lifecycle verbs (restart/terminate/delete), printed as aligned tables.
+
+    python -m hstream_tpu.admin [--host H --port P] <command> [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import grpc
+
+from hstream_tpu.client import format_table
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+
+
+def _stub(args) -> HStreamApiStub:
+    ch = grpc.insecure_channel(f"{args.host}:{args.port}")
+    return HStreamApiStub(ch)
+
+
+def cmd_status(stub, args) -> list[dict]:
+    nodes = stub.ListNodes(pb.ListNodesRequest()).nodes
+    return [{"id": n.id, "address": n.address, "port": n.port,
+             "roles": ",".join(n.roles), "status": n.status}
+            for n in nodes]
+
+
+def cmd_streams(stub, args) -> list[dict]:
+    out = stub.ListStreams(pb.ListStreamsRequest()).streams
+    return [{"stream": s.stream_name,
+             "replication": s.replication_factor} for s in out]
+
+
+def cmd_queries(stub, args) -> list[dict]:
+    out = stub.ListQueries(pb.ListQueriesRequest()).queries
+    return [{"id": q.id, "status": q.status,
+             "created_ms": q.created_time_ms,
+             "sql": q.query_text[:60]} for q in out]
+
+
+def cmd_views(stub, args) -> list[dict]:
+    out = stub.ListViews(pb.ListViewsRequest()).views
+    return [{"view": v.view_id, "status": v.status,
+             "sql": v.sql[:60]} for v in out]
+
+
+def cmd_connectors(stub, args) -> list[dict]:
+    out = stub.ListConnectors(pb.ListConnectorsRequest()).connectors
+    return [{"id": c.id, "status": c.status,
+             "config": c.config[:60]} for c in out]
+
+
+def cmd_subscriptions(stub, args) -> list[dict]:
+    out = stub.ListSubscriptions(pb.ListSubscriptionsRequest())
+    return [{"id": s.subscription_id, "stream": s.stream_name}
+            for s in out.subscription]
+
+
+def cmd_stats(stub, args) -> list[dict]:
+    out = stub.GetStats(pb.GetStatsRequest()).stats
+    rows = []
+    for s in out:
+        row = {"stream": s.stream_name}
+        row.update({k: s.counters[k] for k in sorted(s.counters)})
+        row.update({k: round(s.rates[k], 2) for k in sorted(s.rates)})
+        rows.append(row)
+    return rows
+
+
+def cmd_restart_query(stub, args) -> list[dict]:
+    stub.RestartQuery(pb.RestartQueryRequest(id=args.id))
+    return [{"restarted": args.id}]
+
+
+def cmd_terminate_query(stub, args) -> list[dict]:
+    req = (pb.TerminateQueriesRequest(all=True) if args.id == "all"
+           else pb.TerminateQueriesRequest(query_ids=[args.id]))
+    done = stub.TerminateQueries(req)
+    return [{"terminated": qid} for qid in done.query_ids]
+
+
+def cmd_delete_stream(stub, args) -> list[dict]:
+    stub.DeleteStream(pb.DeleteStreamRequest(stream_name=args.name))
+    return [{"deleted": args.name}]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "hstream-tpu-admin",
+        description="operator CLI over the gRPC admin surface")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6570)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("status", "streams", "queries", "views", "connectors",
+                 "subscriptions", "stats"):
+        sub.add_parser(name)
+    p = sub.add_parser("restart-query")
+    p.add_argument("id")
+    p = sub.add_parser("terminate-query")
+    p.add_argument("id", help="query id, or 'all'")
+    p = sub.add_parser("delete-stream")
+    p.add_argument("name")
+    args = ap.parse_args(argv)
+
+    fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]
+    stub = _stub(args)
+    try:
+        rows = fn(stub, args)
+    except grpc.RpcError as e:
+        print(f"error: {e.details()}", file=sys.stderr)
+        return 1
+    print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+import sys
+
+from hstream_tpu.admin import main
+
+sys.exit(main())

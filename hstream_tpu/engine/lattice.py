@@ -83,7 +83,11 @@ _TOPK_KINDS = (AggKind.TOPK, AggKind.TOPK_DISTINCT)
 
 def agg_width(agg: AggSpec) -> int:
     """Values per key this aggregate emits (k for TOPK, else 1)."""
-    return (agg.k or 10) if agg.kind in _TOPK_KINDS else 1
+    if agg.kind in _TOPK_KINDS:
+        if agg.k is None or agg.k < 1:
+            raise ValueError(f"{agg.kind.value} needs k >= 1, got {agg.k}")
+        return agg.k
+    return 1
 
 
 def init_state(spec: LatticeSpec) -> dict[str, jnp.ndarray]:
@@ -426,6 +430,35 @@ def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
     return outs
 
 
+def _agg_out_rows(spec: LatticeSpec, outs):
+    """Flatten finalized agg outputs into bitcast int32 rows — ONE place
+    defines the row layout (width-k aggs contribute k rows); the unpack
+    inverse is _unpack_agg_rows."""
+    for agg in spec.aggs:
+        o = outs[agg.out_name].astype(jnp.float32)
+        if agg.kind in _TOPK_KINDS:
+            for j in range(agg_width(agg)):
+                yield jax.lax.bitcast_convert_type(o[:, j], jnp.int32)
+        else:
+            yield jax.lax.bitcast_convert_type(o, jnp.int32)
+
+
+def _unpack_agg_rows(spec: LatticeSpec, rows2d: np.ndarray):
+    """Inverse of _agg_out_rows: int32 rows -> {name: [N] or [N, k] f32}."""
+    outs = {}
+    row = 0
+    for agg in spec.aggs:
+        w = agg_width(agg)
+        if agg.kind in _TOPK_KINDS:
+            outs[agg.out_name] = np.stack(
+                [rows2d[row + j].view(np.float32) for j in range(w)],
+                axis=1)
+        else:
+            outs[agg.out_name] = rows2d[row].view(np.float32)
+        row += w
+    return outs
+
+
 def pack_extract_rows(spec: LatticeSpec, count, win_start, outs):
     """Stack (count, win_start, finalized agg outputs) into ONE int32
     buffer [2 + sum(widths), K] (float outputs bitcast) so the host pays
@@ -435,14 +468,7 @@ def pack_extract_rows(spec: LatticeSpec, count, win_start, outs):
     k = count.shape[0]
     rows = [count.astype(jnp.int32),
             jnp.broadcast_to(jnp.asarray(win_start, jnp.int32), (k,))]
-    for agg in spec.aggs:
-        o = outs[agg.out_name].astype(jnp.float32)
-        if agg.kind in _TOPK_KINDS:
-            for j in range(agg_width(agg)):
-                rows.append(jax.lax.bitcast_convert_type(o[:, j],
-                                                         jnp.int32))
-        else:
-            rows.append(jax.lax.bitcast_convert_type(o, jnp.int32))
+    rows.extend(_agg_out_rows(spec, outs))
     return jnp.stack(rows)
 
 
@@ -451,18 +477,7 @@ def unpack_extract_rows(spec: LatticeSpec, packed: np.ndarray):
     pack_extract_rows."""
     count = packed[0]
     win_start = packed[1]
-    outs = {}
-    row = 2
-    for agg in spec.aggs:
-        w = agg_width(agg)
-        if agg.kind in _TOPK_KINDS:
-            outs[agg.out_name] = np.stack(
-                [packed[row + j].view(np.float32) for j in range(w)],
-                axis=1)
-        else:
-            outs[agg.out_name] = packed[row].view(np.float32)
-        row += w
-    return count, win_start, outs
+    return count, win_start, _unpack_agg_rows(spec, packed[2:])
 
 
 def build_extract_slot(spec: LatticeSpec):
@@ -513,31 +528,14 @@ def pack_touched_rows(spec: LatticeSpec, n, kidx, win_start, outs,
     outputs (width-k aggs contribute k rows)."""
     rows = [jnp.zeros((max_out,), jnp.int32).at[0].set(n),
             kidx.astype(jnp.int32), win_start.astype(jnp.int32)]
-    for agg in spec.aggs:
-        o = outs[agg.out_name].astype(jnp.float32)
-        if agg.kind in _TOPK_KINDS:
-            for j in range(agg_width(agg)):
-                rows.append(jax.lax.bitcast_convert_type(o[:, j],
-                                                         jnp.int32))
-        else:
-            rows.append(jax.lax.bitcast_convert_type(o, jnp.int32))
+    rows.extend(_agg_out_rows(spec, outs))
     return jnp.stack(rows)
 
 
 def unpack_touched_rows(spec: LatticeSpec, packed: np.ndarray):
     """(n, kidx [n], win_start [n], {name: [n] or [n, width] f32})."""
     n = int(packed[0, 0])
-    outs = {}
-    row = 3
-    for agg in spec.aggs:
-        w = agg_width(agg)
-        if agg.kind in _TOPK_KINDS:
-            outs[agg.out_name] = np.stack(
-                [packed[row + j, :n].view(np.float32) for j in range(w)],
-                axis=1)
-        else:
-            outs[agg.out_name] = packed[row, :n].view(np.float32)
-        row += w
+    outs = _unpack_agg_rows(spec, packed[3:, :n])
     return n, packed[1, :n], packed[2, :n], outs
 
 

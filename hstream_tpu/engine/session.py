@@ -143,9 +143,13 @@ def _acc_merge(agg: AggSpec, a, b):
     if agg.kind == AggKind.APPROX_QUANTILE:
         return a + b
     if agg.kind == AggKind.TOPK:
-        return sorted(a + b, reverse=True)[: agg.k or 10]
+        from hstream_tpu.engine.lattice import agg_width
+
+        return sorted(a + b, reverse=True)[: agg_width(agg)]
     if agg.kind == AggKind.TOPK_DISTINCT:
-        return sorted(set(a) | set(b), reverse=True)[: agg.k or 10]
+        from hstream_tpu.engine.lattice import agg_width
+
+        return sorted(set(a) | set(b), reverse=True)[: agg_width(agg)]
     raise SQLCodegenError(f"session agg {agg.kind} unsupported")
 
 

@@ -1,12 +1,15 @@
-"""Server boot: flags -> store -> context -> gRPC serve.
+"""Server boot: flags/config file -> store -> context -> gRPC serve.
 
-Reference: hstream/app/server.hs:36-149 (optparse flags; boot = logger ->
-store client -> init checkpoint log -> gRPC event loop).
+Reference: hstream/app/server.hs:36-149 — optparse flags
+(host/port/store/replication/timeout/compression/log-level; "TODO:
+config file" at server.hs:32-34 — here the config file exists). Flags
+override config-file values; see --help for the full surface.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import signal
 from concurrent import futures
 
@@ -15,7 +18,6 @@ import grpc
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.proto.rpc import add_hstream_api_to_server
 from hstream_tpu.server.context import ServerContext
-from hstream_tpu.server.handlers import HStreamApiServicer
 from hstream_tpu.store import open_store
 
 log = get_logger("main")
@@ -31,19 +33,29 @@ def _build_mesh(shape: str):
 
 def serve(host: str = "127.0.0.1", port: int = 6570,
           store_uri: str = "mem://", *, max_workers: int = 32,
-          mesh_shape: str | None = None
+          mesh_shape: str | None = None,
+          sync_interval_ms: int | None = None,
+          segment_bytes: int | None = None,
+          snapshot_interval_ms: int | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
     `mesh_shape` ("DxK", e.g. "4x2") shards eligible aggregate queries
     over a (data, key) device mesh (SURVEY §2.3)."""
-    store = open_store(store_uri)
+    store = open_store(store_uri, sync_interval_ms=sync_interval_ms,
+                       segment_bytes=segment_bytes)
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
     ctx = ServerContext(store, host=host, port=port, mesh=mesh)
+    if snapshot_interval_ms is not None:
+        # per-context, not the QueryTask CLASS attribute: two servers in
+        # one process must not leak cadence into each other's tasks
+        ctx.snapshot_interval_ms = snapshot_interval_ms
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                  ("grpc.max_send_message_length", 64 * 1024 * 1024)])
+    from hstream_tpu.server.handlers import HStreamApiServicer
+
     servicer = HStreamApiServicer(ctx)
     add_hstream_api_to_server(servicer, server)
     bound = server.add_insecure_port(f"{host}:{port}")
@@ -59,20 +71,69 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     return server, ctx
 
 
-def main(argv=None) -> None:
-    ap = argparse.ArgumentParser("hstream-tpu-server")
-    ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=6570)
-    ap.add_argument("--store", default="mem://",
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        "hstream-tpu-server",
+        description="TPU-native streaming database server")
+    ap.add_argument("--config", default=None, metavar="FILE",
+                    help="JSON config file; flags given on the command "
+                         "line override it")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--store", default=None,
                     help="mem:// or a directory path for the native "
                          "durable store")
-    ap.add_argument("--workers", type=int, default=32)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="gRPC worker threads")
     ap.add_argument("--mesh", default=None, metavar="DxK",
                     help="shard aggregate queries over a (data, key) "
                          "device mesh, e.g. 4x2 (needs D*K devices)")
+    ap.add_argument("--log-level", default=None,
+                    choices=["DEBUG", "INFO", "WARNING", "ERROR"])
+    ap.add_argument("--sync-interval-ms", type=int, default=None,
+                    help="native store group-commit fsync cadence")
+    ap.add_argument("--segment-bytes", type=int, default=None,
+                    help="native store segment roll size")
+    ap.add_argument("--snapshot-interval-ms", type=int, default=None,
+                    help="operator-state snapshot + checkpoint cadence")
     args = ap.parse_args(argv)
-    server, ctx = serve(args.host, args.port, args.store,
-                        max_workers=args.workers, mesh_shape=args.mesh)
+
+    defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
+                "workers": 32, "mesh": None, "log_level": None,
+                "sync_interval_ms": None, "segment_bytes": None,
+                "snapshot_interval_ms": None}
+    if args.config:
+        with open(args.config) as f:
+            file_cfg = json.load(f)
+        unknown = set(file_cfg) - set(defaults)
+        if unknown:
+            raise SystemExit(
+                f"unknown config key(s) {sorted(unknown)}; "
+                f"valid: {sorted(defaults)}")
+        defaults.update(file_cfg)
+    for key in defaults:
+        v = getattr(args, key)
+        if v is not None:
+            defaults[key] = v
+    return defaults
+
+
+def main(argv=None) -> None:
+    cfg = _parse_args(argv)
+    if cfg["log_level"]:
+        import logging
+
+        level = str(cfg["log_level"]).upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "ERROR"):
+            raise SystemExit(f"invalid log_level {cfg['log_level']!r}")
+        # project logs ride the non-propagating 'hstream_tpu' logger
+        logging.getLogger("hstream_tpu").setLevel(level)
+    server, ctx = serve(
+        cfg["host"], cfg["port"], cfg["store"],
+        max_workers=cfg["workers"], mesh_shape=cfg["mesh"],
+        sync_interval_ms=cfg["sync_interval_ms"],
+        segment_bytes=cfg["segment_bytes"],
+        snapshot_interval_ms=cfg["snapshot_interval_ms"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -68,6 +68,10 @@ class QueryTask(threading.Thread):
         self.plan = plan
         self.sink = sink
         self.from_beginning = from_beginning
+        # per-context override wins over the class default (main.serve)
+        ctx_iv = getattr(ctx, "snapshot_interval_ms", None)
+        if ctx_iv is not None:
+            self.snapshot_interval_ms = ctx_iv
         self.executor = None
         self.error: BaseException | None = None
         # serializes executor state mutation (this thread) against pull
@@ -78,6 +82,13 @@ class QueryTask(threading.Thread):
         self.sink_dump: Callable[[], Any] | None = None
         self.sink_load: Callable[[Any], None] | None = None
         self._stop_ev = threading.Event()
+        # readiness: set once the reader is attached to every source at
+        # its start LSN — tests and callers wait on this instead of
+        # sleeping (the notification mechanism the reference's test tier
+        # lacks: "FIXME: requires a notification mechanism",
+        # RunSQLSpec.hs:54)
+        self.attached = threading.Event()
+        self.attached_lsns: dict[int, int] = {}  # logid -> start LSN
         self._sources: dict[int, str] = {}  # logid -> stream name
         for name in self.source_streams():
             self._sources[ctx.streams.get_logid(name)] = name
@@ -129,11 +140,15 @@ class QueryTask(threading.Thread):
             resumed = self._restore_state()
             for logid in self._sources:
                 if resumed is not None and logid in resumed:
-                    reader.start_reading(logid, resumed[logid] + 1)
+                    start = resumed[logid] + 1
+                    reader.start_reading(logid, start)
                 else:
-                    reader.start_reading_from_checkpoint(logid, LSN_MIN)
+                    start = reader.start_reading_from_checkpoint(
+                        logid, LSN_MIN)
+                self.attached_lsns[logid] = start
             ctx.persistence.set_query_status(self.info.query_id,
                                              TaskStatus.RUNNING)
+            self.attached.set()
             while not self._stop_ev.is_set():
                 results = reader.read(READ_CHUNK)
                 if not results:

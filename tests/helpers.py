@@ -1,0 +1,36 @@
+"""Shared test helpers: readiness waits instead of sleeps.
+
+SURVEY §4 flags the reference's sleep-based test sync ("FIXME: requires
+a notification mechanism", RunSQLSpec.hs:54); QueryTask.attached is
+that mechanism — set once the reader is attached to every source at its
+start LSN (tasks.attached_lsns)."""
+
+from __future__ import annotations
+
+import time
+
+
+def wait_attached(ctx, query_id: str, timeout: float = 10.0):
+    """Block until the query's task is registered AND attached to its
+    source streams; returns the task."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task = ctx.running_queries.get(query_id)
+        if task is not None and task.attached.wait(0.05):
+            return task
+        time.sleep(0.01)
+    raise TimeoutError(f"query {query_id!r} never attached "
+                       f"(running: {list(ctx.running_queries)})")
+
+
+def wait_any_attached(ctx, timeout: float = 10.0):
+    """Block until at least one running query task is attached (push
+    queries have generated ids the test cannot predict)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for task in list(ctx.running_queries.values()):
+            if getattr(task, "attached", None) is not None \
+                    and task.attached.is_set():
+                return task
+        time.sleep(0.01)
+    raise TimeoutError("no query task attached")

@@ -18,6 +18,8 @@ from hstream_tpu.engine.snapshot import restore_executor, snapshot_executor
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
+
+from helpers import wait_attached
 from hstream_tpu.server.tasks import QueryTask, snapshot_key
 from hstream_tpu.sql.codegen import make_executor, stream_codegen
 
@@ -179,7 +181,7 @@ def _kill_restart_flow(stub, ctx, *, stream, view, restart):
                       "TUMBLING (INTERVAL 10 SECOND) "
                       "GRACE BY INTERVAL 0 SECOND;"))
         qid = f"view-{view}"
-        time.sleep(0.3)
+        wait_attached(ctx, qid)
         # A: 2 sf + 1 la into window [BASE, BASE+10s); stays open
         append_rows(stub, stream,
                     [{"city": "sf"}, {"city": "sf"}, {"city": "la"}],
@@ -206,7 +208,7 @@ def _kill_restart_flow(stub, ctx, *, stream, view, restart):
         # crash: no graceful snapshot
         task.stop(crash=True)
         restart(qid)
-        time.sleep(0.3)
+        wait_attached(ctx, qid)
         # B: one more sf + the closer
         append_rows(stub, stream, [{"city": "sf"}], [BASE + 40])
         append_rows(stub, stream, [{"city": "zz"}], [BASE + 30_000])
@@ -253,7 +255,7 @@ def test_clean_restart_server_native(tmp_path):
                       "FROM crs GROUP BY city, "
                       "TUMBLING (INTERVAL 10 SECOND) "
                       "GRACE BY INTERVAL 0 SECOND;"))
-        time.sleep(0.3)
+        wait_attached(ctx, "view-crv")
         append_rows(stub, "crs", [{"city": "sf"}, {"city": "la"}],
                     [BASE, BASE + 10])
         _poll_view(stub, "crv", lambda rs: len(rs) >= 2)
@@ -263,8 +265,7 @@ def test_clean_restart_server_native(tmp_path):
 
         server, ctx = serve("127.0.0.1", 0, store_dir)
         stub, channel = _stub_for((server, ctx))
-        time.sleep(0.5)
-        assert "view-crv" in ctx.running_queries
+        wait_attached(ctx, "view-crv")
         append_rows(stub, "crs", [{"city": "zz"}], [BASE + 30_000])
         rows = _poll_view(
             stub, "crv",
@@ -295,7 +296,7 @@ def test_kill_restart_server_native(tmp_path):
                       "TUMBLING (INTERVAL 10 SECOND) "
                       "GRACE BY INTERVAL 0 SECOND;"))
         qid = "view-nkv"
-        time.sleep(0.3)
+        wait_attached(ctx, qid)
         append_rows(stub, "nks",
                     [{"city": "sf"}, {"city": "sf"}, {"city": "la"}],
                     [BASE, BASE + 10, BASE + 20])
@@ -317,8 +318,7 @@ def test_kill_restart_server_native(tmp_path):
         # full server restart on the same directory
         server, ctx = serve("127.0.0.1", 0, store_dir)
         stub, channel = _stub_for((server, ctx))
-        time.sleep(0.5)  # boot resume relaunches the view task
-        assert qid in ctx.running_queries
+        wait_attached(ctx, qid)  # boot resume relaunches the view task
         append_rows(stub, "nks", [{"city": "sf"}], [BASE + 40])
         append_rows(stub, "nks", [{"city": "zz"}], [BASE + 30_000])
         rows = _poll_view(

@@ -15,6 +15,8 @@ from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
 
+from helpers import wait_attached
+
 BASE = 1_700_000_000_000
 
 
@@ -74,7 +76,7 @@ def test_repl_scripted_session(stack):
 
 
 def test_repl_ddl_routing_and_pull_query(stack):
-    addr, _, stub, _ = stack
+    addr, _, stub, ctx = stack
     out = io.StringIO()
     client = Client(addr, out=out)
     try:
@@ -83,7 +85,7 @@ def test_repl_ddl_routing_and_pull_query(stack):
             "CREATE VIEW replview AS SELECT city, COUNT(*) AS c "
             "FROM replsrc GROUP BY city, TUMBLING (INTERVAL 10 SECOND) "
             "GRACE BY INTERVAL 0 SECOND;")
-        time.sleep(0.3)
+        wait_attached(ctx, "view-replview")
         from hstream_tpu.common import records as rec
 
         req = pb.AppendRequest(stream_name="replsrc")
@@ -153,7 +155,7 @@ def test_http_query_lifecycle(stack):
 
 
 def test_http_views_and_overview_stats(stack):
-    _, base, stub, _ = stack
+    _, base, stub, ctx = stack
     _http("POST", base, "/streams", {"name": "hvsrc"})
     from hstream_tpu.common import records as rec
 
@@ -167,7 +169,7 @@ def test_http_views_and_overview_stats(stack):
         stmt_text="CREATE VIEW hview AS SELECT k, COUNT(*) AS c "
                   "FROM hvsrc GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-hview")
     _http("POST", base, "/streams/hvsrc/append",
           {"records": [{"k": "a", "__time_ms": BASE},
                        {"k": "a", "__time_ms": BASE + 1},

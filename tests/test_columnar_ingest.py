@@ -14,6 +14,8 @@ from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
 
+from helpers import wait_attached
+
 BASE = 1_700_000_000_000
 
 
@@ -64,14 +66,14 @@ def _view_rows(stub, view, pred, timeout=30):
 
 
 def test_columnar_append_through_view(server_stub):
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="colsrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW colview AS SELECT device, COUNT(*) AS c, "
                   "SUM(temp) AS s FROM colsrc WHERE temp > 0 "
                   "GROUP BY device, TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-colview")
     n = 1000
     ts = BASE + np.arange(n, dtype=np.int64) % 5000
     ts.sort()
@@ -99,14 +101,14 @@ def test_columnar_append_through_view(server_stub):
 def test_columnar_mixed_with_json_records(server_stub):
     """JSON per-record appends and columnar batches interleave on one
     stream; both feed the same aggregation."""
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="mixsrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW mixview AS SELECT k, COUNT(*) AS c "
                   "FROM mixsrc GROUP BY k, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-mixview")
     req = pb.AppendRequest(stream_name="mixsrc")
     req.records.append(rec.build_record({"k": "a"}, publish_time_ms=BASE))
     stub.Append(req)
@@ -136,7 +138,7 @@ def test_malformed_columnar_record_is_skipped(server_stub):
                   "FROM badsrc GROUP BY k, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-badview")
     req = pb.AppendRequest(stream_name="badsrc")
     req.records.append(rec.build_record(columnar.MAGIC))  # truncated
     req.records.append(rec.build_record(
@@ -158,7 +160,7 @@ def test_columnar_records_reach_connector_sink(server_stub, tmp_path):
     drop them while advancing the checkpoint."""
     import sqlite3
 
-    stub, _ = server_stub
+    stub, ctx = server_stub
     db = tmp_path / "colsink.db"
     conn = sqlite3.connect(db)
     conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
@@ -187,14 +189,14 @@ def test_columnar_records_reach_connector_sink(server_stub, tmp_path):
 def test_float_group_key_consistent_across_formats(server_stub):
     """A float GROUP BY value must land in ONE group whether it arrived
     as a JSON python float or a columnar f32 (canon_key)."""
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="fkey"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW fkeyv AS SELECT g, COUNT(*) AS c "
                   "FROM fkey GROUP BY g, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-fkeyv")
     req = pb.AppendRequest(stream_name="fkey")
     req.records.append(rec.build_record({"g": 20.1},
                                         publish_time_ms=BASE))
@@ -212,14 +214,14 @@ def test_float_group_key_consistent_across_formats(server_stub):
 
 
 def test_columnar_numeric_group_key(server_stub):
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="numcol"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW numcolv AS SELECT sensor, COUNT(*) AS c "
                   "FROM numcol GROUP BY sensor, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-numcolv")
     _append_columnar(stub, "numcol", BASE + np.arange(6, dtype=np.int64),
                      {"sensor": np.array([1, 2, 1, 3, 2, 1])})
     _append_columnar(stub, "numcol", np.array([BASE + 30_000]),

@@ -24,6 +24,8 @@ from hstream_tpu.common import records as rec
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
+
+from helpers import wait_attached
 from hstream_tpu.server.views import Materialization
 
 BASE = 1_700_000_000_000
@@ -78,14 +80,14 @@ def test_view_rowkey_stateless_keeps_every_row():
 def test_view_pull_query_numeric_group_key(server_stub):
     """End-to-end: a view grouped on a numeric column serves every
     distinct key (pre-fix: all numeric keys collapsed to one row)."""
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="numsrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW numview AS SELECT sensor, COUNT(*) AS c "
                   "FROM numsrc GROUP BY sensor, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-numview")
     append_rows(stub, "numsrc",
                 [{"sensor": 1, "v": 1.0}, {"sensor": 2, "v": 2.0},
                  {"sensor": 2, "v": 3.0}],
@@ -126,14 +128,14 @@ def test_emitted_group_cols_resolves_aliases():
 
 
 def test_view_pull_query_aliased_group_key(server_stub):
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="aliassrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW aliasview AS SELECT city AS c, "
                   "COUNT(*) AS n FROM aliassrc GROUP BY city, "
                   "TUMBLING (INTERVAL 10 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-aliasview")
     append_rows(stub, "aliassrc",
                 [{"city": "sf"}, {"city": "la"}, {"city": "la"}],
                 [BASE, BASE + 1, BASE + 2])
@@ -246,14 +248,14 @@ def test_dead_consumer_batches_are_redelivered(server_stub):
 def test_view_peek_concurrent_with_ingest(server_stub):
     """Hammer pull queries while the query task is mid-aggregation; no
     request may fail (pre-fix: unlocked iteration over mutating state)."""
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="racesrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW raceview AS SELECT city, COUNT(*) AS c "
                   "FROM racesrc GROUP BY city, "
                   "TUMBLING (INTERVAL 1 SECOND) "
                   "GRACE BY INTERVAL 0 SECOND;"))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-raceview")
     errors: list[BaseException] = []
     stop = threading.Event()
 

@@ -12,6 +12,8 @@ from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
 
+from helpers import wait_any_attached, wait_attached
+
 BASE = 1_700_000_000_000
 
 
@@ -98,7 +100,7 @@ def test_push_query_end_to_end(server_stub):
     t = threading.Thread(target=consume, daemon=True)
     t.start()
     started.wait(5)
-    time.sleep(0.5)  # let the query task attach to the source stream
+    wait_any_attached(ctx)  # query task attached to the source stream
     append_rows(stub, "weather",
                 [{"city": "sf", "temp": 1.0}, {"city": "sf", "temp": 2.0},
                  {"city": "la", "temp": 3.0}],
@@ -200,7 +202,7 @@ def test_subscription_resume_from_checkpoint(server_stub):
 
 
 def test_view_pull_query(server_stub):
-    stub, _ = server_stub
+    stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="vsrc"))
     stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="CREATE VIEW v1 AS SELECT city, COUNT(*) AS c "
@@ -209,7 +211,7 @@ def test_view_pull_query(server_stub):
                   "GRACE BY INTERVAL 0 SECOND;"))
     views = stub.ListViews(pb.ListViewsRequest()).views
     assert any(v.view_id == "v1" for v in views)
-    time.sleep(0.5)
+    wait_attached(ctx, "view-v1")
     append_rows(stub, "vsrc",
                 [{"city": "sf"}, {"city": "sf"}, {"city": "la"}],
                 [BASE, BASE + 1, BASE + 2])

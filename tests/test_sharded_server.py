@@ -13,6 +13,8 @@ from hstream_tpu.common import records as rec
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
+
+from helpers import wait_attached
 from hstream_tpu.server.tasks import QueryTask, snapshot_key
 
 BASE = 1_700_000_000_000
@@ -28,10 +30,10 @@ def _spawn(mesh_shape):
     return server, ctx, ch, HStreamApiStub(ch)
 
 
-def _feed_and_read(stub, rows, ts):
+def _feed_and_read(ctx, stub, rows, ts):
     stub.CreateStream(pb.Stream(stream_name="src"))
     stub.ExecuteQuery(pb.CommandQuery(stmt_text=SQL))
-    time.sleep(0.3)
+    wait_attached(ctx, "view-v")
     req = pb.AppendRequest(stream_name="src")
     for row, t in zip(rows, ts):
         req.records.append(rec.build_record(row, publish_time_ms=t))
@@ -91,8 +93,8 @@ def test_sharded_server_equals_single_chip():
     s1, c1, ch1, stub1 = _spawn(None)
     s2, c2, ch2, stub2 = _spawn("2x2")
     try:
-        single = _feed_and_read(stub1, rows, ts)
-        sharded = _feed_and_read(stub2, rows, ts)
+        single = _feed_and_read(c1, stub1, rows, ts)
+        sharded = _feed_and_read(c2, stub2, rows, ts)
         task = c2.running_queries["view-v"]
         assert type(task.executor).__name__ == "ShardedQueryExecutor"
         assert _rows_close(single, sharded), (single, sharded)
@@ -114,7 +116,7 @@ def test_sharded_kill_restart_resumes():
         stub.CreateStream(pb.Stream(stream_name="src"))
         stub.ExecuteQuery(pb.CommandQuery(stmt_text=SQL))
         qid = "view-v"
-        time.sleep(0.3)
+        wait_attached(ctx, qid)
         req = pb.AppendRequest(stream_name="src")
         for i in range(20):
             req.records.append(rec.build_record(
@@ -132,8 +134,7 @@ def test_sharded_kill_restart_resumes():
         assert ctx.store.meta_get(snapshot_key(qid)) is not None
         ctx.running_queries[qid].stop(crash=True)
         stub.RestartQuery(pb.RestartQueryRequest(id=qid))
-        time.sleep(0.3)
-        task = ctx.running_queries[qid]
+        task = wait_attached(ctx, qid)
         req = pb.AppendRequest(stream_name="src")
         req.records.append(rec.build_record({"device": "d0", "temp": 2.0},
                                             publish_time_ms=BASE + 100))

@@ -256,7 +256,8 @@ def test_push_query_uses_async_sink_on_native_store(tmp_path):
         t = threading.Thread(target=consume, daemon=True)
         t.start()
         started.wait(5)
-        time.sleep(0.5)
+        from helpers import wait_any_attached
+        wait_any_attached(ctx)
         req = pb.AppendRequest(stream_name="asink")
         for i in range(4):
             req.records.append(rec.build_record(

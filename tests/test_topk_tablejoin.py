@@ -11,6 +11,8 @@ from hstream_tpu.engine.snapshot import restore_executor, snapshot_executor
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 from hstream_tpu.server.main import serve
+
+from helpers import wait_attached
 from hstream_tpu.sql.codegen import make_executor, stream_codegen
 
 BASE = 1_700_000_000_000
@@ -199,7 +201,7 @@ def test_table_join_through_server():
                       "ON ord.item = prc.item GROUP BY ord.item, "
                       "TUMBLING (INTERVAL 10 SECOND) "
                       "GRACE BY INTERVAL 0 SECOND;"))
-        time.sleep(0.3)
+        wait_attached(ctx, "view-tj")
         req = pb.AppendRequest(stream_name="prc")
         req.records.append(rec.build_record({"item": "x", "price": 2.0},
                                             publish_time_ms=BASE))
@@ -246,7 +248,7 @@ def test_topk_through_server_view():
                       "FROM tks GROUP BY d, "
                       "TUMBLING (INTERVAL 10 SECOND) "
                       "GRACE BY INTERVAL 0 SECOND;"))
-        time.sleep(0.3)
+        wait_attached(ctx, "view-tkv")
         req = pb.AppendRequest(stream_name="tks")
         for i, v in enumerate([3.0, 9.0, 5.0]):
             req.records.append(rec.build_record(
