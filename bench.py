@@ -444,14 +444,23 @@ def main() -> None:
     ex.drain_closed()
     force(ex)
 
+    import contextlib
+    import os
+
+    from hstream_tpu.common.tracing import jax_profiler
+
+    profile_dir = os.environ.get("HSTREAM_PROFILE_DIR")
+    prof = (jax_profiler(profile_dir) if profile_dir
+            else contextlib.nullcontext())
     emitted_rows = 0
     t_start = time.perf_counter()
-    for _ in range(MEASURE_BATCHES):
-        kids, ts, cols = src.next()
-        pipe.submit(kids, ts, cols)
-    pipe.flush()
-    emitted_rows += len(ex.drain_closed())
-    force(ex)  # all dispatched work is inside the timed region
+    with prof:  # HSTREAM_PROFILE_DIR=... captures a TensorBoard trace
+        for _ in range(MEASURE_BATCHES):
+            kids, ts, cols = src.next()
+            pipe.submit(kids, ts, cols)
+        pipe.flush()
+        emitted_rows += len(ex.drain_closed())
+        force(ex)  # all dispatched work is inside the timed region
     elapsed = time.perf_counter() - t_start
 
     events = MEASURE_BATCHES * BATCH

@@ -75,6 +75,15 @@ def cmd_stats(stub, args) -> list[dict]:
     return rows
 
 
+def cmd_trace(stub, args) -> list[dict]:
+    from hstream_tpu.common import records as rec
+
+    summary = rec.struct_to_dict(
+        stub.GetQueryTrace(pb.GetQueryRequest(id=args.id)))
+    return [{"stage": stage, **vals}
+            for stage, vals in sorted(summary.items())]
+
+
 def cmd_restart_query(stub, args) -> list[dict]:
     stub.RestartQuery(pb.RestartQueryRequest(id=args.id))
     return [{"restarted": args.id}]
@@ -102,6 +111,8 @@ def main(argv=None) -> int:
     for name in ("status", "streams", "queries", "views", "connectors",
                  "subscriptions", "stats"):
         sub.add_parser(name)
+    p = sub.add_parser("trace")
+    p.add_argument("id", help="running query id (e.g. view-<name>)")
     p = sub.add_parser("restart-query")
     p.add_argument("id")
     p = sub.add_parser("terminate-query")

@@ -1,0 +1,88 @@
+"""First-class step tracing (SURVEY §5.1).
+
+The reference has no tracing at all — its closest artifact is a
+logDebug inside the poll loop (Processor.hs:131-133). Here every query
+task records per-batch stage timings (decode, key-encode, device step,
+emission, snapshot) into a bounded ring per query, cheap enough to stay
+always-on: one perf_counter pair per stage, no allocation beyond the
+ring slot.
+
+`trace_span(tracer, stage)` is the instrumentation point;
+`QueryTracer.summary()` aggregates count/total/mean/p50/p95 per stage
+for the admin surface (admin CLI `trace` command, HTTP /queries/<id>).
+`jax_profiler(path)` wraps jax.profiler.trace for deep device profiles
+(TensorBoard format) when an operator asks for one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict, deque
+
+
+class QueryTracer:
+    """Bounded per-stage duration rings for one query."""
+
+    def __init__(self, capacity: int = 512):
+        self._cap = capacity
+        self._rings: dict[str, deque[float]] = defaultdict(
+            lambda: deque(maxlen=capacity))
+        self._counts: dict[str, int] = defaultdict(int)
+        self._totals: dict[str, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def record(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._rings[stage].append(seconds)
+            self._counts[stage] += 1
+            self._totals[stage] += seconds
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """stage -> {count, total_ms, mean_ms, p50_ms, p95_ms} over the
+        ring (percentiles) and lifetime (count/total)."""
+        out: dict[str, dict[str, float]] = {}
+        with self._lock:
+            for stage, ring in self._rings.items():
+                if not ring:
+                    continue
+                xs = sorted(ring)
+                n = len(xs)
+                out[stage] = {
+                    "count": self._counts[stage],
+                    "total_ms": round(self._totals[stage] * 1e3, 3),
+                    "mean_ms": round(
+                        self._totals[stage] / self._counts[stage] * 1e3,
+                        3),
+                    "p50_ms": round(xs[n // 2] * 1e3, 3),
+                    "p95_ms": round(xs[min(n - 1, (n * 95) // 100)] * 1e3,
+                                    3),
+                }
+        return out
+
+
+@contextlib.contextmanager
+def trace_span(tracer: QueryTracer | None, stage: str):
+    """Time a stage into the tracer; no-op when tracer is None."""
+    if tracer is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        tracer.record(stage, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def jax_profiler(log_dir: str):
+    """Deep device profile (TensorBoard trace format) around a block —
+    the jax.profiler hook SURVEY §5.1 prescribes."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -540,6 +540,15 @@ class HStreamApiServicer:
         return self._node_pb()
 
     @unary
+    def GetQueryTrace(self, request, context):
+        """Per-stage timing summary of a RUNNING query (decode /
+        key_encode / step / emit / snapshot rings — SURVEY §5.1)."""
+        task = self.ctx.running_queries.get(request.id)
+        if task is None:
+            raise QueryNotFound(request.id)
+        return rec.dict_to_struct(task.tracer.summary())
+
+    @unary
     def GetStats(self, request, context):
         """Expose the stats holder (counters + time-series rates) — the
         observability the reference keeps native-only

@@ -31,6 +31,7 @@ import numpy as np
 from hstream_tpu.common import columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.logger import get_logger
+from hstream_tpu.common.tracing import QueryTracer, trace_span
 from hstream_tpu.engine.snapshot import (
     capture_executor,
     restore_executor,
@@ -93,6 +94,8 @@ class QueryTask(threading.Thread):
         for name in self.source_streams():
             self._sources[ctx.streams.get_logid(name)] = name
         self._reader: CheckpointedReader | None = None
+        # always-on per-stage timing rings (SURVEY §5.1)
+        self.tracer = QueryTracer()
         self._pending_ckps: dict[int, int] = {}  # processed, not committed
         self._last_snapshot_ms = 0.0
         self._dirty = False
@@ -210,6 +213,10 @@ class QueryTask(threading.Thread):
             self._snapshot_now()
 
     def _snapshot_now(self) -> None:
+        with trace_span(self.tracer, "snapshot"):
+            self._snapshot_now_inner()
+
+    def _snapshot_now_inner(self) -> None:
         """Atomically persist (operator state, read checkpoints): one
         meta-KV write. Read positions NEVER advance past durable state —
         the reference's failure mode (commit-then-lose-state undercount)
@@ -248,6 +255,25 @@ class QueryTask(threading.Thread):
     # ---- processing --------------------------------------------------------
 
     def _process_batch(self, batch: DataBatch) -> None:
+        # phase 1 (timed as "decode"): parse + classify + JSON decode;
+        # phase 2 runs the engine OUTSIDE the decode span so nested
+        # key_encode/step/emit spans are not double-counted
+        items: list[tuple[str, Any, int]] = []
+        with trace_span(self.tracer, "decode"):
+            for payload in batch.payloads:
+                r = rec.parse_record(payload)
+                if (r.header.flag == rec.pb.RECORD_FLAG_RAW
+                        and columnar.is_columnar(r.payload)):
+                    items.append(("col", r.payload, 0))
+                    continue
+                d = rec.record_to_dict(r)
+                if d is None:
+                    continue  # raw records skipped, like the reference's
+                    # JSON-flag filter (HStore.hs:119-143)
+                items.append(
+                    ("row", d,
+                     r.header.publish_time_ms or batch.append_time_ms))
+
         rows: list[dict[str, Any]] = []
         ts: list[int] = []
 
@@ -257,21 +283,15 @@ class QueryTask(threading.Thread):
                 rows.clear()
                 ts.clear()
 
-        for payload in batch.payloads:
-            r = rec.parse_record(payload)
-            if (r.header.flag == rec.pb.RECORD_FLAG_RAW
-                    and columnar.is_columnar(r.payload)):
+        for kind, val, t in items:
+            if kind == "col":
                 # columnar batch payload: the high-throughput producer
                 # path — flush accumulated JSON rows first (order)
                 flush_rows()
-                self._run_columnar(r.payload, batch)
-                continue
-            d = rec.record_to_dict(r)
-            if d is None:
-                continue  # raw records skipped, like the reference's
-                # JSON-flag filter (HStore.hs:119-143)
-            rows.append(d)
-            ts.append(r.header.publish_time_ms or batch.append_time_ms)
+                self._run_columnar(val, batch)
+            else:
+                rows.append(val)
+                ts.append(t)
         flush_rows()
 
     def _query_mesh(self):
@@ -297,24 +317,27 @@ class QueryTask(threading.Thread):
         with self.state_lock:
             if self.executor is None:
                 self.executor = self._make_executor(rows, len(rows))
-            if self.is_join:
-                out = self.executor.process(
-                    rows, ts, stream=self._sources[batch.logid])
-            else:
-                out = self.executor.process(rows, ts)
+            with trace_span(self.tracer, "step"):
+                if self.is_join:
+                    out = self.executor.process(
+                        rows, ts, stream=self._sources[batch.logid])
+                else:
+                    out = self.executor.process(rows, ts)
             # sink under the lock: a window removed from live state must
             # appear in the sink (view closed rows) atomically with the
             # removal, or a concurrent pull-query snapshot sees it in
             # neither half (no lock-order cycle: views.snapshot releases
             # the materialization lock before taking state_lock)
             if out:
-                self.sink(out)
+                with trace_span(self.tracer, "emit"):
+                    self.sink(out)
 
     # ---- columnar fast path ------------------------------------------------
 
     def _run_columnar(self, payload: bytes, batch: DataBatch) -> None:
         try:
-            ts, cols = columnar.decode_columnar(payload)
+            with trace_span(self.tracer, "decode"):
+                ts, cols = columnar.decode_columnar(payload)
             if len(ts) == 0:
                 return
         except Exception:  # noqa: BLE001 — a malformed/forged payload
@@ -330,18 +353,25 @@ class QueryTask(threading.Thread):
             ex = self.executor
             if self.is_join or not hasattr(ex, "process_columnar"):
                 # joins / sessions / stateless: row materialization
-                rws = _rows_from_columnar(ts, cols)
-                if self.is_join:
-                    out = ex.process(rws, ts.tolist(),
-                                     stream=self._sources[batch.logid])
-                else:
-                    out = ex.process(rws, ts.tolist())
+                with trace_span(self.tracer, "decode"):
+                    rws = _rows_from_columnar(ts, cols)
+                with trace_span(self.tracer, "step"):
+                    if self.is_join:
+                        out = ex.process(
+                            rws, ts.tolist(),
+                            stream=self._sources[batch.logid])
+                    else:
+                        out = ex.process(rws, ts.tolist())
             else:
-                key_ids = _columnar_key_ids(ex, cols, len(ts))
-                dev_cols, nulls = _device_columns(ex, cols, len(ts))
-                out = ex.process_columnar(key_ids, ts, dev_cols, nulls)
+                with trace_span(self.tracer, "key_encode"):
+                    key_ids = _columnar_key_ids(ex, cols, len(ts))
+                    dev_cols, nulls = _device_columns(ex, cols, len(ts))
+                with trace_span(self.tracer, "step"):
+                    out = ex.process_columnar(key_ids, ts, dev_cols,
+                                              nulls)
             if out:
-                self.sink(out)
+                with trace_span(self.tracer, "emit"):
+                    self.sink(out)
 
 
 def _sample_rows(ts: "np.ndarray", cols: dict, k: int = 8) -> list[dict]:

@@ -23,14 +23,18 @@ def wait_attached(ctx, query_id: str, timeout: float = 10.0):
                        f"(running: {list(ctx.running_queries)})")
 
 
-def wait_any_attached(ctx, timeout: float = 10.0):
-    """Block until at least one running query task is attached (push
-    queries have generated ids the test cannot predict)."""
+def wait_any_attached(ctx, timeout: float = 10.0, *, exclude=()):
+    """Block until a running query task OUTSIDE `exclude` is attached
+    (push queries have generated ids the test cannot predict; pass the
+    pre-existing query ids so a stale attached task cannot satisfy the
+    wait)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
-        for task in list(ctx.running_queries.values()):
+        for qid, task in list(ctx.running_queries.items()):
+            if qid in exclude:
+                continue
             if getattr(task, "attached", None) is not None \
                     and task.attached.is_set():
                 return task
         time.sleep(0.01)
-    raise TimeoutError("no query task attached")
+    raise TimeoutError("no (new) query task attached")

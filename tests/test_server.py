@@ -83,6 +83,7 @@ def test_push_query_end_to_end(server_stub):
     stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="weather"))
     got: list[dict] = []
+    pre_existing = set(ctx.running_queries)
     started = threading.Event()
 
     def consume():
@@ -100,7 +101,7 @@ def test_push_query_end_to_end(server_stub):
     t = threading.Thread(target=consume, daemon=True)
     t.start()
     started.wait(5)
-    wait_any_attached(ctx)  # query task attached to the source stream
+    wait_any_attached(ctx, exclude=pre_existing)  # new task attached
     append_rows(stub, "weather",
                 [{"city": "sf", "temp": 1.0}, {"city": "sf", "temp": 2.0},
                  {"city": "la", "temp": 3.0}],

@@ -257,7 +257,7 @@ def test_push_query_uses_async_sink_on_native_store(tmp_path):
         t.start()
         started.wait(5)
         from helpers import wait_any_attached
-        wait_any_attached(ctx)
+        wait_any_attached(ctx)  # fresh server: no pre-existing tasks
         req = pb.AppendRequest(stream_name="asink")
         for i in range(4):
             req.records.append(rec.build_record(
