@@ -178,6 +178,14 @@ class QueryExecutor:
         # emission — the TPU analogue of the reference's sink append).
         self.defer_close_decode = False
         self._pending_closes: list[tuple[int, Any]] = []
+        # Deferred CHANGE decode (emit_changes mode): keep the touched
+        # extract as a device value and decode it one batch later, so
+        # the blocking device->host fetch overlaps the next batch's host
+        # work instead of stalling the loop (matters on high-RTT links).
+        # Changes then lag emission by one micro-batch; flush_changes()
+        # drains the tail.
+        self.defer_change_decode = False
+        self._pending_changes: list[Any] = []
 
     def _extract_filter(self) -> Expr | None:
         # Walk the child chain down to the source, ANDing every FilterNode
@@ -742,11 +750,32 @@ class QueryExecutor:
 
     def _drain_changes(self) -> list[dict[str, Any]]:
         self.state, packed = self._extract_touched(self.state)
+        if not self.defer_change_decode:
+            return self._decode_changes(np.asarray(packed), self.epoch)
+        # the epoch is captured WITH the extract: a rebase between
+        # extract and the deferred decode must not shift window bounds
+        self._pending_changes.append((self.epoch, packed))
+        rows: list[dict[str, Any]] = []
+        while len(self._pending_changes) > 1:
+            epoch, buf = self._pending_changes.pop(0)
+            rows.extend(self._decode_changes(np.asarray(buf), epoch))
+        return rows
+
+    def flush_changes(self) -> list[dict[str, Any]]:
+        """Decode every deferred changelog extract (forces the queue)."""
+        rows: list[dict[str, Any]] = []
+        while self._pending_changes:
+            epoch, buf = self._pending_changes.pop(0)
+            rows.extend(self._decode_changes(np.asarray(buf), epoch))
+        return rows
+
+    def _decode_changes(self, packed: np.ndarray,
+                        epoch: int | None) -> list[dict[str, Any]]:
         n, kidx, win_start_rel, outs_np = lattice.unpack_touched_rows(
-            self.spec, np.asarray(packed))
+            self.spec, packed)
         rows = []
         for i in range(n):
-            ws = (int(win_start_rel[i]) + self.epoch
+            ws = (int(win_start_rel[i]) + epoch
                   if self.window is not None else None)
             row = self._agg_row(int(kidx[i]), outs_np, i, ws)
             if row is not None:

@@ -167,6 +167,12 @@ def _lattice_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
     if ex._pending_closes:
         raise SQLCodegenError(
             "snapshot with deferred closes pending; drain_closed() first")
+    if getattr(ex, "_pending_changes", None):
+        # the touched mask was already cleared on device: the queued
+        # extracts are the ONLY copy of those change rows
+        raise SQLCodegenError(
+            "snapshot with deferred changes pending; flush_changes() "
+            "first")
     meta = {
         "kind": "lattice",
         "n_keys": ex.spec.n_keys,
