@@ -50,8 +50,35 @@ class ServerContext:
         self.port = port
         self.server_id = server_id
         from hstream_tpu.stats import StatsHolder
+        from hstream_tpu.store.versioned import VersionedConfigStore
 
         self.stats = StatsHolder()
+        # CAS-versioned cluster config (reference VersionedConfigStore);
+        # first consumer: the boot-epoch counter below — each server
+        # boot on a store CAS-increments it, so concurrent servers on
+        # one store lose the race visibly instead of corrupting state
+        self.config = VersionedConfigStore(store)
+        self.boot_epoch = self._bump_boot_epoch()
+
+    def _bump_boot_epoch(self) -> int:
+        from hstream_tpu.store.versioned import VersionMismatch
+
+        for _ in range(16):
+            cur = self.config.get("cluster/boot_epoch")
+            try:
+                if cur is None:
+                    self.config.put("cluster/boot_epoch", b"1")
+                    return 1
+                version, raw = cur
+                epoch = int(raw) + 1
+                self.config.put("cluster/boot_epoch",
+                                str(epoch).encode(),
+                                base_version=version)
+                return epoch
+            except VersionMismatch:
+                continue
+        raise RuntimeError("boot-epoch CAS kept losing; another server "
+                           "is racing this store")
 
     def shutdown(self) -> None:
         for task in list(self.running_queries.values()):

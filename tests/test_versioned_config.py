@@ -1,0 +1,85 @@
+"""CAS-versioned config store (reference hs_versioned_config_store.cpp)
++ its boot-epoch consumer."""
+
+import threading
+
+import pytest
+
+from hstream_tpu.store import open_store
+from hstream_tpu.store.native import NativeLogStore
+from hstream_tpu.store.versioned import VersionedConfigStore, VersionMismatch
+
+
+def test_create_update_delete_cycle():
+    vcs = VersionedConfigStore(open_store("mem://"))
+    assert vcs.get("a") is None
+    assert vcs.put("a", b"v1") == 1
+    assert vcs.get("a") == (1, b"v1")
+    with pytest.raises(VersionMismatch):
+        vcs.put("a", b"again")          # create on existing
+    with pytest.raises(VersionMismatch):
+        vcs.put("a", b"x", base_version=7)  # wrong base
+    assert vcs.put("a", b"v2", base_version=1) == 2
+    assert vcs.get("a") == (2, b"v2")
+    with pytest.raises(VersionMismatch):
+        vcs.delete("a", base_version=1)
+    vcs.delete("a", base_version=2)
+    assert vcs.get("a") is None
+    # re-create after delete continues the version chain (tombstone CAS)
+    assert vcs.put("a", b"v3") == 4
+    vcs.delete("a", base_version=4)
+    vcs.put("x", b"1")
+    vcs.put("y", b"2")
+    assert vcs.keys() == ["x", "y"]
+
+
+def test_concurrent_cas_single_winner_per_round():
+    store = open_store("mem://")
+    vcs = VersionedConfigStore(store)
+    vcs.put("c", b"0")
+    wins, losses = [], []
+    barrier = threading.Barrier(8)
+
+    def bump(t):
+        barrier.wait(5)
+        for _ in range(50):
+            cur = vcs.get("c")
+            try:
+                vcs.put("c", str(int(cur[1]) + 1).encode(),
+                        base_version=cur[0])
+                wins.append(t)
+            except VersionMismatch:
+                losses.append(t)
+
+    threads = [threading.Thread(target=bump, args=(t,)) for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    version, value = vcs.get("c")
+    # every applied write bumped the version AND the counter exactly once
+    assert version == 1 + len(wins)
+    assert int(value) == len(wins)
+
+
+def test_versions_survive_native_reopen(tmp_path):
+    root = str(tmp_path / "st")
+    store = NativeLogStore(root)
+    vcs = VersionedConfigStore(store)
+    vcs.put("cfg", b"one")
+    vcs.put("cfg", b"two", base_version=1)
+    store.close()
+    re = NativeLogStore(root)
+    assert VersionedConfigStore(re).get("cfg") == (2, b"two")
+    re.close()
+
+
+def test_boot_epoch_increments_across_server_boots(tmp_path):
+    from hstream_tpu.server.main import serve
+
+    store_dir = str(tmp_path / "store")
+    for expected in (1, 2, 3):
+        server, ctx = serve("127.0.0.1", 0, store_dir)
+        assert ctx.boot_epoch == expected
+        server.stop(grace=1)
+        ctx.shutdown()
