@@ -469,15 +469,28 @@ def main() -> None:
     events = MEASURE_BATCHES * BATCH
     with prof:  # HSTREAM_PROFILE_DIR=... captures a TensorBoard trace
         for _run in range(3):
-            t_start = time.perf_counter()
-            for _ in range(MEASURE_BATCHES):
-                kids, ts, cols = src.next()
-                pipe.submit(kids, ts, cols)
-            pipe.flush()
-            emitted_rows += len(ex.drain_closed())
-            force(ex)  # all dispatched work inside the timed region
-            dt = time.perf_counter() - t_start
-            runs.append((events / dt, dt))
+            try:
+                t_start = time.perf_counter()
+                for _ in range(MEASURE_BATCHES):
+                    kids, ts, cols = src.next()
+                    pipe.submit(kids, ts, cols)
+                pipe.flush()
+                emitted_rows += len(ex.drain_closed())
+                force(ex)  # all dispatched work inside timed region
+                dt = time.perf_counter() - t_start
+                runs.append((events / dt, dt))
+            except Exception as e:  # noqa: BLE001 — transient tunnel
+                # failures must not void the whole benchmark record
+                print(f"# run {_run} failed: {type(e).__name__}: {e}",
+                      flush=True)
+                try:  # drain leftovers so the next run starts clean
+                    pipe.flush()
+                    ex.drain_closed()
+                    force(ex)
+                except Exception:
+                    pass
+    if not runs:
+        raise RuntimeError("all headline runs failed")
     eps, elapsed = max(runs)  # best run, with ITS measured wall time
 
     close_ms = measure_close_latency(ex, pipe, src)
@@ -500,8 +513,8 @@ def main() -> None:
         "elapsed_s": round(elapsed, 3),
         "methodology": "best_of_3_sustained_runs",
         "runs_eps": [round(r) for r, _ in runs],
-        "median_eps": round(sorted(r for r, _ in runs)[1]),
-        "total_events": 3 * MEASURE_BATCHES * BATCH,
+        "median_eps": round(sorted(r for r, _ in runs)[len(runs) // 2]),
+        "total_events": len(runs) * MEASURE_BATCHES * BATCH,
         "emitted_rows": emitted_rows,  # across all 3 runs
         "p99_window_close_ms": (round(p99_close, 2)
                                 if p99_close is not None else None),
@@ -511,14 +524,27 @@ def main() -> None:
         "rtt_ms": round(rtt_ms, 1),
         "platform": jax.devices()[0].platform,
     }
-    result.update(server_path_eps())
+    def safe(label, fn, *a):
+        try:
+            return fn(*a)
+        except Exception as e:  # noqa: BLE001 — keep the record partial
+            print(f"# {label} failed: {type(e).__name__}: {e}",
+                  flush=True)
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    sp = safe("server_path", server_path_eps)
+    if "error" in sp:
+        result["server_path_error"] = sp["error"]
+    else:
+        result.update(sp)
     import tempfile
 
     result["configs"] = {
-        "hop_multi_agg": bench_config2_hop_multi(),
-        "session_quantile": bench_config4_session_quantile(),
-        "join_groupby": bench_config5_join_view(),
-        "store_append": bench_store_append(tempfile.gettempdir()),
+        "hop_multi_agg": safe("cfg2", bench_config2_hop_multi),
+        "session_quantile": safe("cfg4", bench_config4_session_quantile),
+        "join_groupby": safe("cfg5", bench_config5_join_view),
+        "store_append": safe("store", bench_store_append,
+                             tempfile.gettempdir()),
     }
     print(json.dumps(result))
     pipe.close()

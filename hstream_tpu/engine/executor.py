@@ -149,7 +149,8 @@ class QueryExecutor:
         self._needed_cols = sorted(needed)
 
         self.spec = lattice.LatticeSpec(
-            n_keys=initial_keys, window=self.window, aggs=tuple(encoded_aggs))
+            n_keys=initial_keys, window=self.window,
+            aggs=tuple(encoded_aggs), track_touched=emit_changes)
         self.state = lattice.init_state(self.spec)
         # sticky adaptive wire codec; survives recompiles (key growth).
         # The lock serializes encode() between an IngestPipeline encoder
@@ -266,7 +267,8 @@ class QueryExecutor:
         self.state = lattice.grow_keys(self.state, self.spec, new_k)
         self.spec = lattice.LatticeSpec(
             n_keys=new_k, window=self.spec.window, aggs=self.spec.aggs,
-            hll=self.spec.hll, qcfg=self.spec.qcfg)
+            hll=self.spec.hll, qcfg=self.spec.qcfg,
+            track_touched=self.spec.track_touched)
         self._compile()
 
     # ---- time --------------------------------------------------------------

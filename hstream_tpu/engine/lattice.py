@@ -64,6 +64,9 @@ class LatticeSpec:
     aggs: tuple[AggSpec, ...]
     hll: HLLConfig = HLLConfig()
     qcfg: QuantileConfig = QuantileConfig()
+    # changelog tracking (EMIT CHANGES): when False the per-batch
+    # `touched` scatter is skipped — one fewer memory pass per record
+    track_touched: bool = True
 
     @property
     def n_slots(self) -> int:
@@ -99,7 +102,9 @@ def init_state(spec: LatticeSpec) -> dict[str, jnp.ndarray]:
     }
     for i, agg in enumerate(spec.aggs):
         name = _plane_name(i, agg)
-        if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+        if agg.kind == AggKind.COUNT_ALL:
+            continue  # aliases the built-in `count` plane (same mask)
+        if agg.kind == AggKind.COUNT:
             state[name] = jnp.zeros((K, W), jnp.int32)
         elif agg.kind == AggKind.SUM:
             state[name] = jnp.zeros((K, W), jnp.float32)
@@ -193,16 +198,15 @@ def build_step_fn(spec: LatticeSpec,
         out["slot_start"] = state["slot_start"].at[
             jnp.where(ok_slot.reshape(-1), slots.reshape(-1), W)].max(
             flat_starts, mode="drop")
-        out["touched"] = state["touched"].at[flat_k, flat_s].set(
-            True, mode="drop")
+        if spec.track_touched:
+            out["touched"] = state["touched"].at[flat_k, flat_s].set(
+                True, mode="drop")
 
         for i, agg in enumerate(spec.aggs):
             name = _plane_name(i, agg)
             vfn, null_key = agg_inputs[i]
             if agg.kind == AggKind.COUNT_ALL:
-                out[name] = state[name].at[flat_k, flat_s].add(
-                    flat_ok.astype(jnp.int32), mode="drop")
-                continue
+                continue  # reads the built-in `count` plane at finalize
             v = vfn(cols)                                        # [B]
             # input validity: not SQL NULL, and finite for float inputs
             input_ok = jnp.ones(v.shape, jnp.bool_)
@@ -409,7 +413,9 @@ def finalize_column(spec: LatticeSpec, state_col: Mapping[str, jnp.ndarray]):
     outs = {}
     for i, agg in enumerate(spec.aggs):
         name = _plane_name(i, agg)
-        if agg.kind == AggKind.AVG:
+        if agg.kind == AggKind.COUNT_ALL:
+            outs[agg.out_name] = state_col["count"].astype(jnp.float32)
+        elif agg.kind == AggKind.AVG:
             denom = jnp.maximum(state_col[name + "_n"].astype(jnp.float32), 1.0)
             outs[agg.out_name] = state_col[name] / denom
         elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
@@ -501,6 +507,8 @@ def build_reset_slot(spec: LatticeSpec):
     def reset(state, slot):
         out = dict(state)
         for i, agg in enumerate(spec.aggs):
+            if agg.kind == AggKind.COUNT_ALL:
+                continue  # no own plane; `count` below resets it
             name = _plane_name(i, agg)
             out[name] = state[name].at[:, slot].set(init_value(agg))
             if agg.kind == AggKind.AVG:
@@ -579,6 +587,8 @@ def plane_merge_kinds(spec: LatticeSpec) -> dict[str, str]:
     kinds = {"count": "sum", "touched": "max", "slot_start": "max"}
     for i, agg in enumerate(spec.aggs):
         name = _plane_name(i, agg)
+        if agg.kind == AggKind.COUNT_ALL:
+            continue  # no own plane
         if agg.kind == AggKind.MIN:
             kinds[name] = "min"
         elif agg.kind in (AggKind.MAX, AggKind.APPROX_COUNT_DISTINCT):

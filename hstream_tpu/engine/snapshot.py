@@ -284,6 +284,10 @@ def _scatter_state(ex, canonical: dict[str, np.ndarray]):
     sh = ex._sharded
     out = {}
     for k, v in canonical.items():
+        if k not in identities:
+            # plane from an older snapshot format (e.g. the removed
+            # COUNT_ALL alias plane): now derived, safe to drop
+            continue
         ident = np.asarray(identities[k])
         g = np.broadcast_to(ident[None],
                             (sh.n_data,) + ident.shape).copy()

@@ -87,7 +87,8 @@ class ShardedQueryExecutor(QueryExecutor):
             grown[k] = np.pad(v, pad, constant_values=fill)
         self.spec = se_lattice.LatticeSpec(
             n_keys=new_k, window=self.spec.window, aggs=self.spec.aggs,
-            hll=self.spec.hll, qcfg=self.spec.qcfg)
+            hll=self.spec.hll, qcfg=self.spec.qcfg,
+            track_touched=self.spec.track_touched)
         self._defer_state_init = True
         try:
             self._compile()

@@ -87,7 +87,8 @@ class ShardedLattice:
         self.spec = spec
         self.local_spec = LatticeSpec(
             n_keys=spec.n_keys // self.n_key, window=spec.window,
-            aggs=spec.aggs, hll=spec.hll, qcfg=spec.qcfg)
+            aggs=spec.aggs, hll=spec.hll, qcfg=spec.qcfg,
+            track_touched=spec.track_touched)
         self.max_out = max_out
 
         agg_inputs, self.null_keys = compile_agg_inputs(spec, schema)
@@ -190,6 +191,8 @@ class ShardedLattice:
         def reset_local(state, slot):
             out = dict(state)
             for i, agg in enumerate(local_spec.aggs):
+                if agg.kind == lattice.AggKind.COUNT_ALL:
+                    continue  # aliases `count`, reset below
                 name = lattice._plane_name(i, agg)
                 out[name] = state[name].at[:, :, slot].set(init_value(agg))
                 if agg.kind == lattice.AggKind.AVG:
