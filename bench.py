@@ -40,11 +40,11 @@ import numpy as np
 
 TARGET = 10_000_000  # events/s, BASELINE.md north star
 N_KEYS = 1000
-BATCH = 1 << 19            # records per micro-batch
+BATCH = 1 << 20            # records per micro-batch
 STREAM_MS_PER_BATCH = 200  # stream time per batch -> close every 50 batches
 N_UNIQUE = 8               # distinct pre-generated batches, cycled
-WARMUP_BATCHES = 60        # spans one window close (compiles extract/reset)
-MEASURE_BATCHES = 150      # spans three window closes
+WARMUP_BATCHES = 55        # spans one window close (compiles extract/reset)
+MEASURE_BATCHES = 100      # spans two window closes
 PIPELINE_DEPTH = 4
 
 
