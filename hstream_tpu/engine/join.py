@@ -19,13 +19,13 @@ reference's in-memory store grows forever.
 
 from __future__ import annotations
 
-import bisect
 from dataclasses import replace
 from typing import Any, Mapping, Sequence
 
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
+from hstream_tpu.engine.statestore import LastValueStore, TimestampedKVStore
 from hstream_tpu.engine.types import canon_key
 from hstream_tpu.engine.window import DEFAULT_GRACE_MS
 
@@ -99,41 +99,9 @@ def split_on_condition(on: Expr, left_streams: set[str],
     return lks, rks
 
 
-class _SideStore:
-    """Per-side timestamped KV store: key -> (sorted ts list, rows list).
-    The reference's TimestampedKVStore tksPut/tksRange
-    (Processing/Store.hs)."""
-
-    def __init__(self) -> None:
-        self.by_key: dict[tuple, tuple[list[int], list[dict]]] = {}
-
-    def put(self, key: tuple, ts: int, row: dict) -> None:
-        tss, rows = self.by_key.setdefault(key, ([], []))
-        i = bisect.bisect_right(tss, ts)
-        tss.insert(i, ts)
-        rows.insert(i, row)
-
-    def range(self, key: tuple, lo: int, hi: int):
-        """Rows with lo <= ts <= hi for this key (tksRange)."""
-        ent = self.by_key.get(key)
-        if ent is None:
-            return []
-        tss, rows = ent
-        i = bisect.bisect_left(tss, lo)
-        j = bisect.bisect_right(tss, hi)
-        return list(zip(tss[i:j], rows[i:j]))
-
-    def prune(self, min_ts: int) -> None:
-        dead = []
-        for key, (tss, rows) in self.by_key.items():
-            i = bisect.bisect_left(tss, min_ts)
-            if i:
-                del tss[:i]
-                del rows[:i]
-            if not tss:
-                dead.append(key)
-        for key in dead:
-            del self.by_key[key]
+# the interval join's side stores ARE the reference's TimestampedKVStore
+# shape; one shared implementation lives in engine.statestore
+_SideStore = TimestampedKVStore
 
 
 class _JoinBase:
@@ -248,8 +216,14 @@ class TableJoinExecutor(_JoinBase):
                  batch_capacity: int = 4096):
         super().__init__(plan, initial_keys=initial_keys,
                          batch_capacity=batch_capacity)
-        # key -> (ts of latest row, row): the keyed last-value table
-        self.table: dict[tuple, tuple[int, dict]] = {}
+        # the keyed last-value table (engine.statestore.LastValueStore)
+        self._table = LastValueStore()
+
+    @property
+    def table(self) -> dict:
+        """key -> (ts, row) view of the last-value table (snapshots,
+        introspection)."""
+        return self._table.data
 
     def process(self, rows: Sequence[Mapping[str, Any]],
                 ts_ms: Sequence[int], stream: str | None = None
@@ -260,10 +234,7 @@ class TableJoinExecutor(_JoinBase):
                 key = self._key(self.right_keys, row)
                 if key is None:
                     continue
-                ts = int(ts)
-                cur = self.table.get(key)
-                if cur is None or ts >= cur[0]:
-                    self.table[key] = (ts, dict(row))
+                self._table.update(key, int(ts), row)
             return []
         joined: list[dict[str, Any]] = []
         jts: list[int] = []
@@ -271,10 +242,10 @@ class TableJoinExecutor(_JoinBase):
             key = self._key(self.left_keys, row)
             if key is None:
                 continue
-            ent = self.table.get(key)
-            if ent is None:
+            match = self._table.lookup(key)
+            if match is None:
                 continue  # INNER: stream rows without a table row drop
-            joined.append(self._joined_row(row, ent[1]))
+            joined.append(self._joined_row(row, match))
             jts.append(int(ts))
         if not joined:
             return []

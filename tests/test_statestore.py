@@ -1,0 +1,30 @@
+"""Reusable state-store surface (reference Store.hs classes)."""
+
+from hstream_tpu.engine.statestore import (
+    LastValueStore,
+    TimestampedKVStore,
+)
+
+
+def test_timestamped_kvstore_range_prune():
+    ts_store = TimestampedKVStore()
+    for t in (30, 10, 20):
+        ts_store.put(("a",), t, {"t": t})
+    ts_store.put(("b",), 15, {"t": 15})
+    assert [t for t, _ in ts_store.range(("a",), 10, 20)] == [10, 20]
+    assert ts_store.range(("zz",), 0, 99) == []
+    ts_store.prune(15)
+    assert [t for t, _ in ts_store.range(("a",), 0, 99)] == [20, 30]
+    assert ts_store.range(("b",), 0, 99) == [(15, {"t": 15})]
+    ts_store.prune(99)
+    assert ts_store.by_key == {}
+
+
+def test_last_value_store_out_of_order():
+    lv = LastValueStore()
+    lv.update(("k",), 10, {"v": "old"})
+    lv.update(("k",), 30, {"v": "new"})
+    lv.update(("k",), 20, {"v": "stale"})  # must not clobber newer
+    assert lv.lookup(("k",)) == {"v": "new"}
+    assert lv.lookup(("other",)) is None
+    assert len(lv) == 1
