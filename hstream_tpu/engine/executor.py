@@ -93,6 +93,10 @@ class StagedBatch:
 class QueryExecutor:
     """Executes one windowed/global GROUP BY aggregation plan."""
 
+    # whether _drain_changes honors defer_change_decode (subclasses with
+    # their own drain path override this capability)
+    supports_deferred_changes = True
+
     def __init__(
         self,
         node: AggregateNode,

@@ -27,6 +27,10 @@ class ShardedQueryExecutor(QueryExecutor):
     logged; size capacity generously for production queries.
     """
 
+    # the sharded drain path (drain_touched) is synchronous; the
+    # deferral flag would be a silent no-op here
+    supports_deferred_changes = False
+
     def __init__(self, node: AggregateNode, schema: Schema, *, mesh,
                  data_axis: str = "data", key_axis: str = "key",
                  emit_changes: bool = True, initial_keys: int = 1024,

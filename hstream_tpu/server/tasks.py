@@ -155,6 +155,7 @@ class QueryTask(threading.Thread):
             while not self._stop_ev.is_set():
                 results = reader.read(READ_CHUNK)
                 if not results:
+                    self._flush_deferred_changes()
                     self._maybe_snapshot()
                     continue
                 for r in results:
@@ -194,8 +195,9 @@ class QueryTask(threading.Thread):
         if blob is None:
             return None
         with self.state_lock:
-            self.executor, extra = restore_executor(
+            ex, extra = restore_executor(
                 self.plan, blob, mesh=self._query_mesh())
+            self.executor = self._tune_executor(ex)
             if self.sink_load is not None and "sink" in extra:
                 self.sink_load(extra["sink"])
         ckps = {int(k): int(v) for k, v in extra.get("ckps", {}).items()}
@@ -205,6 +207,18 @@ class QueryTask(threading.Thread):
                  self.info.query_id, ckps)
         return ckps
 
+    def _flush_deferred_changes(self) -> None:
+        """Drain deferred changelog extracts to the sink (idle ticks and
+        pre-snapshot — the snapshot guard requires an empty queue)."""
+        ex = self.executor
+        if ex is None or not getattr(ex, "_pending_changes", None):
+            return
+        with self.state_lock:
+            rows = ex.flush_changes()
+            if rows:
+                with trace_span(self.tracer, "emit"):
+                    self.sink(rows)
+
     def _maybe_snapshot(self) -> None:
         if not self._dirty:
             return
@@ -213,6 +227,7 @@ class QueryTask(threading.Thread):
             self._snapshot_now()
 
     def _snapshot_now(self) -> None:
+        self._flush_deferred_changes()
         with trace_span(self.tracer, "snapshot"):
             self._snapshot_now_inner()
 
@@ -310,8 +325,20 @@ class QueryTask(threading.Thread):
         # producer sending 256k-row batches must not be split into 64
         # separate device round-trips by the default 4096 capacity
         cap = min(max(round_up_pow2(first_n, lo=4096), 4096), 1 << 19)
-        return make_executor(self.plan, sample_rows=sample_rows,
-                             batch_capacity=cap, mesh=self._query_mesh())
+        ex = make_executor(self.plan, sample_rows=sample_rows,
+                           batch_capacity=cap, mesh=self._query_mesh())
+        return self._tune_executor(ex)
+
+    @staticmethod
+    def _tune_executor(ex):
+        """Per-task executor tuning, applied on BOTH the fresh and the
+        snapshot-restore paths."""
+        if getattr(ex, "emit_changes", False) and \
+                getattr(ex, "supports_deferred_changes", False):
+            # pipeline the changelog fetch behind the next batch's work;
+            # the idle tick flushes so rows lag <= one poll cycle
+            ex.defer_change_decode = True
+        return ex
 
     def _run_rows(self, rows: list, ts: list, batch: DataBatch) -> None:
         with self.state_lock:
