@@ -30,6 +30,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from hstream_tpu.common.errors import SQLCodegenError
@@ -806,12 +807,23 @@ class QueryExecutor:
         return rows
 
     def drain_closed(self) -> list[dict[str, Any]]:
-        """Decode every deferred window close (forces the device queue)."""
+        """Decode every deferred window close (forces the device queue).
+        Multiple pending closes fetch in ONE device->host transfer —
+        fetch count, not bytes, dominates drain cost on real links."""
+        if not self._pending_closes:
+            return []
         rows: list[dict[str, Any]] = []
-        for start_abs, packed_dev in self._pending_closes:
-            rows.extend(self._decode_extract(np.asarray(packed_dev),
-                                             start_abs))
-        self._pending_closes.clear()
+        if len(self._pending_closes) == 1:
+            start_abs, packed_dev = self._pending_closes[0]
+            rows = self._decode_extract(np.asarray(packed_dev), start_abs)
+            self._pending_closes.clear()  # only after decode succeeded
+            return rows
+        starts = [s for s, _ in self._pending_closes]
+        stacked = np.asarray(jnp.stack(
+            [p for _, p in self._pending_closes]))
+        for start_abs, packed in zip(starts, stacked):
+            rows.extend(self._decode_extract(packed, start_abs))
+        self._pending_closes.clear()  # only after every decode succeeded
         return rows
 
     def close_due_windows(self) -> list[dict[str, Any]]:
