@@ -26,8 +26,8 @@ import numpy as np
 
 MAGIC = b"HSCB1\x00"
 
-_KIND_DTYPE = {"f32": np.float32, "i64": np.int64, "bool": np.uint8,
-               "str": np.int32}
+_KIND_DTYPE = {"f32": np.float32, "f64": np.float64, "i64": np.int64,
+               "bool": np.uint8, "str": np.int32}
 
 
 def is_columnar(payload: bytes) -> bool:
@@ -36,10 +36,11 @@ def is_columnar(payload: bytes) -> bool:
 
 def encode_columnar(ts_ms: np.ndarray,
                     cols: Mapping[str, np.ndarray | list],
-                    ) -> bytes:
+                    *, float_kind: str = "f32") -> bytes:
     """Columns -> payload bytes. String columns (lists or object/str
     arrays) are dictionary-encoded; numeric arrays are cast to
-    f32/i64/bool."""
+    f32/i64/bool. float_kind="f64" keeps float columns at full double
+    precision (sink emission of host-finalized aggregates)."""
     ts = np.ascontiguousarray(ts_ms, np.int64)
     n = len(ts)
     meta_cols: list[list[str]] = []
@@ -59,8 +60,8 @@ def encode_columnar(ts_ms: np.ndarray,
             data = arr.astype(np.int64)
             kind = "i64"
         else:
-            data = arr.astype(np.float32)
-            kind = "f32"
+            kind = float_kind
+            data = arr.astype(_KIND_DTYPE[kind])
         if len(data) != n:
             raise ValueError(f"column {name!r} length {len(data)} != {n}")
         meta_cols.append([name, kind])
@@ -120,3 +121,98 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
                     f"string column {name!r} ids out of dict range")
         cols[name] = (kind, arr, d)
     return ts, cols
+
+
+def to_rows(ts: np.ndarray, cols: dict,
+            nulls: Mapping[str, np.ndarray] | None = None,
+            ) -> list[dict[str, Any]]:
+    """Materialize decoded columns back into per-row dicts (consumers
+    that need row shape: joins, sessions, connectors, push-query
+    streaming). `nulls` marks missing/null cells -> None. f64 columns
+    (native JSON decode, sink emission) intify integral values, matching
+    records.record_to_dict's Struct number decoding."""
+    host = {}
+    for name, (kind, arr, d) in cols.items():
+        if kind == "str":
+            vals = [d[int(i)] for i in arr]
+        elif kind == "f64":
+            vals = [int(v) if v.is_integer() else v
+                    for v in arr.tolist()]
+        else:
+            vals = arr.tolist()
+        nm = nulls.get(name) if nulls else None
+        if nm is not None and nm.any():
+            vals = [None if isnull else v
+                    for v, isnull in zip(vals, nm.tolist())]
+        host[name] = vals
+    names = list(host)
+    return [dict(zip(names, vals))
+            for vals in zip(*(host[c] for c in names))]
+
+
+def payload_rows(payload: bytes) -> list[dict[str, Any]] | None:
+    """Rows from a RAW record payload when it carries a columnar batch;
+    None when it is not columnar or is malformed (callers skip it, like
+    any other unrecognized RAW record). The one shared expansion for
+    every columnar-record consumer (push-query streaming, connectors,
+    gateway)."""
+    if not is_columnar(payload):
+        return None
+    try:
+        ts, cols = decode_columnar(payload)
+    except Exception:  # noqa: BLE001 — malformed payloads are skipped
+        return None
+    return to_rows(ts, cols)
+
+
+def rows_to_payload(rows: list[Mapping[str, Any]],
+                    ts_ms: int) -> bytes | None:
+    """One columnar payload for a homogeneous batch of flat scalar rows
+    (the steady-state changelog / window-close output), or None when the
+    rows are not uniformly shaped (heterogeneous keys, NULLs, list
+    values like TOPK) — the caller falls back to per-row records.
+
+    Emitting the sink batch as ONE columnar record instead of N protobuf
+    Structs keeps the server's emit stage off the per-row Python path
+    (the reference serializes one protobuf per sunk record,
+    HStore.hs:152-163)."""
+    if not rows:
+        return None
+    names = list(rows[0])
+    nlen = len(names)
+    if any(len(r) != nlen for r in rows):
+        return None
+    cols: dict[str, Any] = {}
+    try:
+        for c in names:
+            vals = [r[c] for r in rows]
+            v0 = vals[0]
+            if isinstance(v0, bool):
+                if not all(isinstance(v, bool) for v in vals):
+                    return None
+                cols[c] = np.asarray(vals, np.bool_)
+            elif isinstance(v0, int):
+                if not all(type(v) is int for v in vals):
+                    # ints mixed with floats -> f64 keeps exactness of
+                    # both (i64 would truncate, f32 would round counts)
+                    if not all(isinstance(v, (int, float))
+                               and not isinstance(v, bool) for v in vals):
+                        return None
+                    cols[c] = np.asarray(vals, np.float64)
+                else:
+                    cols[c] = np.asarray(vals, np.int64)
+            elif isinstance(v0, float):
+                if not all(isinstance(v, (int, float))
+                           and not isinstance(v, bool) for v in vals):
+                    return None
+                cols[c] = np.asarray(vals, np.float64)
+            elif isinstance(v0, str):
+                if not all(isinstance(v, str) for v in vals):
+                    return None
+                cols[c] = np.asarray(vals, object)
+            else:
+                return None  # None / lists / nested -> per-row records
+    except (KeyError, OverflowError):
+        return None
+    ts = np.full(len(rows), ts_ms, np.int64)
+    return encode_columnar(ts, cols, float_kind="f64")

@@ -23,7 +23,6 @@ from typing import Any, Mapping
 from hstream_tpu.common import columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.errors import ServerError
-from hstream_tpu.server import tasks
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.common.records import flatten_json
 from hstream_tpu.server.persistence import TaskStatus
@@ -179,21 +178,12 @@ class ConnectorTask(threading.Thread):
                     if isinstance(r, DataBatch):
                         for payload in r.payloads:
                             pr = rec.parse_record(payload)
-                            if (pr.header.flag == rec.pb.RECORD_FLAG_RAW
-                                    and columnar.is_columnar(pr.payload)):
+                            if pr.header.flag == rec.pb.RECORD_FLAG_RAW:
                                 # columnar producer batches flow to
                                 # sinks too (same decode as query tasks)
-                                try:
-                                    ts, cols = columnar.decode_columnar(
-                                        pr.payload)
-                                    rows.extend(
-                                        tasks._rows_from_columnar(
-                                            ts, cols))
-                                except Exception:  # noqa: BLE001
-                                    log.warning(
-                                        "connector %s: skipping "
-                                        "malformed columnar record",
-                                        self.connector_id)
+                                crows = columnar.payload_rows(pr.payload)
+                                if crows:
+                                    rows.extend(crows)
                                 continue
                             d = rec.record_to_dict(pr)
                             if d is not None:

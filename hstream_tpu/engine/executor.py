@@ -533,10 +533,17 @@ class QueryExecutor:
             self._no_close.clear()
             self._touched_this_call.clear()
 
+    def _stage_cap(self, n: int) -> int:
+        """Padded capacity for a columnar micro-batch. Floored at 4096
+        (or batch_capacity when smaller) so variable-size coalesced
+        batches share ONE compiled step shape — each distinct cap is a
+        separate XLA compile, and scatter cost at 4096 rows is noise."""
+        return round_up_pow2(n, lo=min(self.batch_capacity, 4096))
+
     def _process_columnar(self, key_ids, ts_ms, cols, nulls
                           ) -> list[dict[str, Any]]:
         n = len(key_ids)
-        cap = round_up_pow2(n, lo=min(self.batch_capacity, 256))
+        cap = self._stage_cap(n)
         if n > self.batch_capacity:
             out = []
             for i in range(0, n, self.batch_capacity):
@@ -633,7 +640,7 @@ class QueryExecutor:
         epoch = self.epoch
         ts_rel64 = ts - epoch
         staged = StagedBatch(
-            n=n, cap=round_up_pow2(n, lo=min(self.batch_capacity, 256)),
+            n=n, cap=self._stage_cap(n),
             combo=None, bases=None, words=None, epoch=epoch,
             ts_min=int(ts.min()), ts_max=int(ts.max()),
             key_ids=key_ids, ts_ms=ts, cols=cols, nulls=nulls)

@@ -58,6 +58,11 @@ class IngestPipeline:
                 self._staged.put(None)
                 return
 
+    @property
+    def pending(self) -> int:
+        """Batches submitted but not yet processed."""
+        return self._pending
+
     def _raise_worker_error(self) -> None:
         if self._err is not None:
             err, self._err = self._err, None
@@ -121,5 +126,11 @@ class IngestPipeline:
         return out
 
     def close(self) -> None:
-        self._in.put(None)
+        if self._worker.is_alive():
+            try:
+                # a worker that died with a full input queue never
+                # drains it — a plain put() would hang this thread
+                self._in.put(None, timeout=5)
+            except queue.Full:
+                pass
         self._worker.join(timeout=5)

@@ -28,7 +28,6 @@ from hstream_tpu.common.errors import (
 )
 from hstream_tpu.common.idgen import gen_unique
 from hstream_tpu.common.logger import get_logger
-from hstream_tpu.connectors import ConnectorTask, make_sink
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.server.context import ServerContext
 from hstream_tpu.server.persistence import (
@@ -216,6 +215,13 @@ class HStreamApiServicer:
                     continue
                 for payload in item.payloads:
                     record = rec.parse_record(payload)
+                    if record.header.flag == rec.pb.RECORD_FLAG_RAW:
+                        # vectorized sink emission: one columnar record
+                        # per changelog batch (tasks.stream_sink)
+                        for row in (columnar.payload_rows(record.payload)
+                                    or ()):
+                            yield rec.dict_to_struct(row)
+                        continue
                     s = rec.payload_to_struct(record)
                     if s is not None:
                         yield s
@@ -829,6 +835,11 @@ class HStreamApiServicer:
         return info
 
     def _start_connector_task(self, info: ConnectorInfo, plan) -> None:
+        # deferred import: connectors imports server.persistence, so a
+        # module-level import here would close an import cycle for
+        # anyone importing hstream_tpu.connectors first
+        from hstream_tpu.connectors import ConnectorTask, make_sink
+
         ctx = self.ctx
         options = plan.options
         source = options.get("STREAM")
