@@ -125,13 +125,20 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
 
 def to_rows(ts: np.ndarray, cols: dict,
             nulls: Mapping[str, np.ndarray] | None = None,
-            ) -> list[dict[str, Any]]:
+            *, drop_null: bool = False) -> list[dict[str, Any]]:
     """Materialize decoded columns back into per-row dicts (consumers
     that need row shape: joins, sessions, connectors, push-query
     streaming). `nulls` marks missing/null cells -> None. f64 columns
     (native JSON decode, sink emission) intify integral values, matching
-    records.record_to_dict's Struct number decoding."""
+    records.record_to_dict's Struct number decoding.
+
+    drop_null=True omits null-masked cells from the row dicts instead of
+    carrying explicit Nones — the shape the per-record decode path
+    produces for a heterogeneous batch (a record never mentions columns
+    it doesn't carry), so executors see the same rows regardless of how
+    the producer batched its appends."""
     host = {}
+    masks = {}
     for name, (kind, arr, d) in cols.items():
         if kind == "str":
             vals = [d[int(i)] for i in arr]
@@ -142,12 +149,24 @@ def to_rows(ts: np.ndarray, cols: dict,
             vals = arr.tolist()
         nm = nulls.get(name) if nulls else None
         if nm is not None and nm.any():
-            vals = [None if isnull else v
-                    for v, isnull in zip(vals, nm.tolist())]
+            if drop_null:
+                masks[name] = nm.tolist()
+            else:
+                vals = [None if isnull else v
+                        for v, isnull in zip(vals, nm.tolist())]
         host[name] = vals
     names = list(host)
-    return [dict(zip(names, vals))
+    if not names:
+        # empty-payload records still ARE records: n empty dicts, like
+        # the per-record decode path (record_to_dict returns {})
+        return [{} for _ in range(len(ts))]
+    rows = [dict(zip(names, vals))
             for vals in zip(*(host[c] for c in names))]
+    for name, mask in masks.items():
+        for row, isnull in zip(rows, mask):
+            if isnull:
+                del row[name]
+    return rows
 
 
 def payload_rows(payload: bytes) -> list[dict[str, Any]] | None:

@@ -184,6 +184,11 @@ class ConnectorTask(threading.Thread):
                                 crows = columnar.payload_rows(pr.payload)
                                 if crows:
                                     rows.extend(crows)
+                                elif columnar.is_columnar(pr.payload):
+                                    log.warning(
+                                        "connector %s: skipping "
+                                        "malformed columnar record",
+                                        self.connector_id)
                                 continue
                             d = rec.record_to_dict(pr)
                             if d is not None:

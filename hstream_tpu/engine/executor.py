@@ -825,11 +825,17 @@ class QueryExecutor:
             rows = self._decode_extract(np.asarray(packed_dev), start_abs)
             self._pending_closes.clear()  # only after decode succeeded
             return rows
-        starts = [s for s, _ in self._pending_closes]
-        stacked = np.asarray(jnp.stack(
-            [p for _, p in self._pending_closes]))
-        for start_abs, packed in zip(starts, stacked):
-            rows.extend(self._decode_extract(packed, start_abs))
+        # Group by buffer shape: grow_keys() between two deferred closes
+        # changes the K dimension, and jnp.stack over mixed shapes raises.
+        by_shape: dict[tuple, list[tuple[int | None, Any]]] = {}
+        for start_abs, packed in self._pending_closes:
+            by_shape.setdefault(tuple(packed.shape), []).append(
+                (start_abs, packed))
+        for group in by_shape.values():
+            starts = [s for s, _ in group]
+            stacked = np.asarray(jnp.stack([p for _, p in group]))
+            for start_abs, packed in zip(starts, stacked):
+                rows.extend(self._decode_extract(packed, start_abs))
         self._pending_closes.clear()  # only after every decode succeeded
         return rows
 

@@ -138,6 +138,11 @@ def restore_executor(plan, blob: bytes, *, initial_keys: int = 1024,
     merges shard partials into ONE canonical lattice, restore scatters
     it back — see _scatter_state)."""
     meta, arrays = _unpack(blob)
+    ver = meta.get("version")
+    if ver != SNAPSHOT_VERSION:
+        raise SQLCodegenError(
+            f"snapshot format version {ver!r} != supported "
+            f"{SNAPSHOT_VERSION}; refusing to deserialize")
     kind = meta["kind"]
     if kind == "tablejoin":
         ex = _restore_table_join(plan, meta, arrays,
@@ -220,8 +225,14 @@ def _merge_partials(ex) -> dict[str, Any]:
         elif kind == "max":
             out[k] = (jnp.any(v, axis=0) if v.dtype == jnp.bool_
                       else jnp.max(v, axis=0).astype(v.dtype))
-        else:
+        elif kind == "sum":
             out[k] = jnp.sum(v, axis=0).astype(v.dtype)
+        else:
+            # e.g. "topk": summing shard partials would corrupt state.
+            # Sharded execution currently rejects such specs upstream;
+            # fail loudly if that restriction is ever lifted.
+            raise SQLCodegenError(
+                f"no shard-merge rule for plane {k!r} (kind {kind!r})")
     return out
 
 

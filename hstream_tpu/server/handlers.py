@@ -738,6 +738,30 @@ class HStreamApiServicer:
                         sampled = True
         missing = referenced - fields
         if sampled and missing:
+            # widen before rejecting: a heterogeneous stream may carry
+            # the column only in batches outside the head/tail sample
+            reader.stop_reading(logid)
+            reader.start_reading(logid, lo, tail)
+            for item in reader.read(512):
+                if not isinstance(item, DataBatch):
+                    continue
+                for payload in item.payloads:
+                    r = rec.parse_record(payload)
+                    if (r.header.flag == rec.pb.RECORD_FLAG_RAW
+                            and columnar.is_columnar(r.payload)):
+                        try:
+                            _, cols = columnar.decode_columnar(r.payload)
+                        except Exception:  # noqa: BLE001
+                            continue
+                        fields |= set(cols)
+                    else:
+                        d = rec.record_to_dict(r)
+                        if d is not None:
+                            fields |= set(d)
+                missing = referenced - fields
+                if not missing:
+                    break
+        if sampled and missing:
             raise ServerError(
                 f"unknown column(s) {sorted(missing)}: not present in "
                 f"recent records of stream {plan.source!r}")

@@ -41,6 +41,38 @@ class RecId:
     idx: int
 
 
+def _expand_columnar(payload: bytes) -> list[bytes] | None:
+    """Expand an internal columnar record (query sinks pack a whole
+    emitted batch into ONE RAW record — tasks.stream_sink) into per-row
+    JSON records for subscription consumers, which speak the reference
+    wire protocol and would otherwise see opaque bytes. None = not a
+    columnar record, deliver verbatim. The RecId batch_index space and
+    the AckWindow's batch size both use the expanded count, so ack
+    bookkeeping stays consistent."""
+    from hstream_tpu.common import columnar, records as rec
+
+    if b"HSCB" not in payload:  # cheap reject before a protobuf parse
+        return None
+    try:
+        r = rec.parse_record(payload)
+        if (r.header.flag != rec.pb.RECORD_FLAG_RAW
+                or not columnar.is_columnar(r.payload)):
+            return None
+        ts, cols = columnar.decode_columnar(r.payload)
+        rows = columnar.to_rows(ts, cols)
+    except Exception:  # noqa: BLE001 — malformed: deliver verbatim
+        return None
+    if not rows:
+        # an empty expansion would note a size-0 batch, which parks the
+        # ack window's lower bound forever; deliver verbatim instead
+        return None
+    pt = r.header.publish_time_ms
+    return [rec.build_record(row, key=r.header.key,
+                             publish_time_ms=int(t) if t else pt)
+            .SerializeToString()
+            for row, t in zip(rows, ts.tolist())]
+
+
 class AckWindow:
     """Ack-range bookkeeping for one subscription (Common.hs:119-166)."""
 
@@ -198,8 +230,15 @@ class SubscriptionRuntime:
         with self.lock:
             for item in results:
                 if isinstance(item, DataBatch):
-                    self.window.note_batch(item.lsn, len(item.payloads))
-                    for i, payload in enumerate(item.payloads):
+                    payloads: list[bytes] = []
+                    for payload in item.payloads:
+                        expanded = _expand_columnar(payload)
+                        if expanded is None:
+                            payloads.append(payload)
+                        else:
+                            payloads.extend(expanded)
+                    self.window.note_batch(item.lsn, len(payloads))
+                    for i, payload in enumerate(payloads):
                         out.append((RecId(item.lsn, i), payload))
                 elif isinstance(item, GapRecord):
                     self.window.note_gap(item.lo_lsn, item.hi_lsn)

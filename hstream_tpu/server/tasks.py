@@ -215,6 +215,11 @@ class QueryTask(threading.Thread):
                 self.sink_load(extra["sink"])
         ckps = {int(k): int(v) for k, v in extra.get("ckps", {}).items()}
         self._pending_ckps = dict(ckps)
+        # re-mirror to the ckp store: a crash between meta_put and
+        # write_checkpoints leaves the observability mirror stale until
+        # the next append; the blob's ckps are authoritative either way
+        if self._reader is not None and self._pending_ckps:
+            self._reader.write_checkpoints(self._pending_ckps)
         self._last_snapshot_ms = time.monotonic() * 1000
         log.info("query %s resumed from snapshot at %s",
                  self.info.query_id, ckps)
@@ -396,7 +401,11 @@ class QueryTask(threading.Thread):
             ex = self.executor
             if self.is_join or not hasattr(ex, "process_columnar"):
                 with trace_span(self.tracer, "decode"):
-                    rws = columnar.to_rows(ts, cols, nulls)
+                    # drop_null: a record never mentions columns it
+                    # doesn't carry — same row shape as the per-record
+                    # decode path, independent of producer batching
+                    rws = columnar.to_rows(ts, cols, nulls,
+                                           drop_null=True)
                 with trace_span(self.tracer, "step"):
                     if self.is_join:
                         out = ex.process(rws, ts.tolist(),
@@ -496,7 +505,7 @@ class QueryTask(threading.Thread):
             if self.is_join or not hasattr(ex, "process_columnar"):
                 # joins / sessions / stateless: row materialization
                 with trace_span(self.tracer, "decode"):
-                    rws = _rows_from_columnar(ts, cols)
+                    rws = columnar.to_rows(ts, cols)
                 with trace_span(self.tracer, "step"):
                     if self.is_join:
                         out = ex.process(
@@ -545,11 +554,8 @@ def _sample_rows(ts: "np.ndarray", cols: dict,
         ts[:n], {name: (kind, arr[:n], d)
                  for name, (kind, arr, d) in cols.items()},
         None if nulls is None else {name: m[:n]
-                                    for name, m in nulls.items()})
-
-
-def _rows_from_columnar(ts: "np.ndarray", cols: dict) -> list[dict]:
-    return columnar.to_rows(ts, cols)
+                                    for name, m in nulls.items()},
+        drop_null=True)
 
 
 def _columnarize_rows(ex, rows: list) -> tuple:
@@ -656,11 +662,18 @@ def _columnar_key_ids(ex, cols: dict, n: int,
         col_codes.append(codes)
     if len(col_vals) == 1:
         # single group column: map each distinct value to its key id
-        # once, then one LUT gather over the batch
+        # once, then one LUT gather over the batch. Register ONLY codes
+        # that occur in the batch: vals can carry values absent from
+        # every (unmasked) row — bool's fixed [False, True] domain, or
+        # unique() placeholders from null-masked cells — and a phantom
+        # key id would ride every snapshot and could force a needless
+        # key-capacity grow.
         vals = col_vals[0]
-        kid_lut = np.fromiter((ex.key_id_for((v,)) for v in vals),
-                              np.int32, len(vals))
-        return kid_lut[col_codes[0]]
+        codes = col_codes[0]
+        kid_lut = np.zeros(len(vals), np.int32)
+        for p in np.unique(codes).tolist():
+            kid_lut[p] = ex.key_id_for((vals[p],))
+        return kid_lut[codes]
     radix = 1
     for vals in col_vals:
         radix *= max(len(vals), 1)

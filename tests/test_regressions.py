@@ -292,3 +292,121 @@ def test_view_peek_concurrent_with_ingest(server_stub):
     for t in threads:
         t.join(10)
     assert not errors, [str(e) for e in errors]
+
+
+# ---- (f) sink columnar records must reach subscribers as JSON rows ----------
+
+
+def test_subscription_expands_packed_columnar(server_stub):
+    """A columnar-packed record (what stream_sink emits for >=32-row
+    batches) must be delivered to Fetch consumers as individual JSON
+    records, not one opaque RAW blob."""
+    import numpy as np
+
+    from hstream_tpu.common import columnar
+
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="packed"))
+    rows = [{"k": f"x{i}", "c": i} for i in range(40)]
+    payload = columnar.rows_to_payload(rows, BASE)
+    assert payload is not None
+    req = pb.AppendRequest(stream_name="packed")
+    req.records.append(rec.build_record(payload, publish_time_ms=BASE))
+    stub.Append(req)
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="sub-packed", stream_name="packed"))
+    got = stub.Fetch(pb.FetchRequest(
+        subscription_id="sub-packed", timeout_ms=2000, max_size=100))
+    recs = got.received_records
+    assert len(recs) == 40, len(recs)
+    seen = []
+    for rr in recs:
+        r = rec.parse_record(rr.record)
+        assert r.header.flag == rec.pb.RECORD_FLAG_JSON
+        seen.append(rec.record_to_dict(r))
+    assert seen == rows
+    # ack indices over the expanded space commit cleanly
+    stub.Acknowledge(pb.AcknowledgeRequest(
+        subscription_id="sub-packed",
+        ack_ids=[rr.record_id for rr in recs]))
+
+
+# ---- (g) batch decode row shape matches per-record decode -------------------
+
+
+def test_to_rows_drop_null_matches_per_record_shape():
+    import numpy as np
+
+    from hstream_tpu.common import columnar
+
+    ts = np.array([BASE, BASE + 1], np.int64)
+    cols = {"a": ("f64", np.array([1.0, 0.0]), None),
+            "b": ("f64", np.array([0.0, 2.0]), None)}
+    nulls = {"a": np.array([False, True]),
+             "b": np.array([True, False])}
+    rows = columnar.to_rows(ts, cols, nulls, drop_null=True)
+    assert rows == [{"a": 1}, {"b": 2}]
+    # default keeps explicit Nones (sink/gateway consumers)
+    rows = columnar.to_rows(ts, cols, nulls)
+    assert rows == [{"a": 1, "b": None}, {"a": None, "b": 2}]
+
+
+# ---- (h) bool group keys: only present values registered --------------------
+
+
+def test_bool_group_key_no_phantom_ids():
+    import numpy as np
+
+    from hstream_tpu.engine import (
+        AggKind, AggSpec, AggregateNode, ColumnType, QueryExecutor,
+        Schema, SourceNode, TumblingWindow)
+    from hstream_tpu.engine.expr import Col
+    from hstream_tpu.server.tasks import _columnar_key_ids
+
+    schema = Schema.of(flag=ColumnType.BOOL, v=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("s", schema), group_keys=[Col("flag")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "c")])
+    ex = QueryExecutor(node, schema, emit_changes=False,
+                       initial_keys=4, batch_capacity=64)
+    cols = {"flag": ("bool", np.ones(8, np.bool_), None)}
+    kids = _columnar_key_ids(ex, cols, 8)
+    assert len(set(kids.tolist())) == 1
+    assert len(ex._key_rev) == 1  # no phantom False key registered
+
+
+def test_to_rows_empty_payload_records_preserved():
+    import numpy as np
+
+    from hstream_tpu.common import columnar
+
+    ts = np.array([BASE, BASE + 1, BASE + 2], np.int64)
+    assert columnar.to_rows(ts, {}, {}) == [{}, {}, {}]
+
+
+def test_empty_columnar_record_delivered_verbatim(server_stub):
+    """A zero-row columnar record must NOT expand to an empty batch
+    (which would park the ack window forever) — it is delivered as the
+    one opaque record it is, and the checkpoint still advances."""
+    import numpy as np
+
+    from hstream_tpu.common import columnar
+
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="edgy"))
+    empty = columnar.encode_columnar(np.empty(0, np.int64), {})
+    req = pb.AppendRequest(stream_name="edgy")
+    req.records.append(rec.build_record(empty, publish_time_ms=BASE))
+    req.records.append(rec.build_record({"k": "a"}, publish_time_ms=BASE))
+    stub.Append(req)
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="sub-edgy", stream_name="edgy"))
+    got = stub.Fetch(pb.FetchRequest(
+        subscription_id="sub-edgy", timeout_ms=2000, max_size=10))
+    assert len(got.received_records) == 2
+    stub.Acknowledge(pb.AcknowledgeRequest(
+        subscription_id="sub-edgy",
+        ack_ids=[rr.record_id for rr in got.received_records]))
+    rt = ctx.subscriptions.get("sub-edgy")
+    assert rt.committed_lsn > 0  # ack window advanced
