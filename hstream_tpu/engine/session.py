@@ -59,7 +59,9 @@ def hll_update_np(values: np.ndarray, cfg: HLLConfig):
     return reg, rank
 
 
-def hll_estimate_np(registers: np.ndarray, cfg: HLLConfig) -> float:
+def hll_estimate_np(registers: np.ndarray, cfg: HLLConfig) -> np.ndarray:
+    """HLL estimate over the last axis: accepts one register set [m] or
+    a batch [..., m] (batched session closes finalize in one call)."""
     m = cfg.m
     if m == 16:
         alpha = 0.673
@@ -69,12 +71,11 @@ def hll_estimate_np(registers: np.ndarray, cfg: HLLConfig) -> float:
         alpha = 0.709
     else:
         alpha = 0.7213 / (1 + 1.079 / m)
-    regs = registers.astype(np.float64)
-    raw = alpha * m * m / np.sum(np.exp2(-regs))
-    zeros = int(np.sum(registers == 0))
-    if raw <= 2.5 * m and zeros > 0:
-        return m * math.log(m / zeros)
-    return float(raw)
+    regs = np.asarray(registers).astype(np.float64)
+    raw = alpha * m * m / np.sum(np.exp2(-regs), axis=-1)
+    zeros = np.sum(np.asarray(registers) == 0, axis=-1)
+    lin = m * np.log(m / np.maximum(zeros, 1))
+    return np.where((raw <= 2.5 * m) & (zeros > 0), lin, raw)
 
 
 def quantile_bin_np(values: np.ndarray, cfg: QuantileConfig) -> np.ndarray:
@@ -87,17 +88,18 @@ def quantile_bin_np(values: np.ndarray, cfg: QuantileConfig) -> np.ndarray:
 
 
 def quantile_estimate_np(hist: np.ndarray, q: float,
-                         cfg: QuantileConfig) -> float:
-    total = hist.sum()
-    if total == 0:
-        return 0.0
-    cdf = np.cumsum(hist)
-    idx = int(np.searchsorted(cdf, q * total, side="left"))
-    idx = min(idx, cfg.n_bins - 1)
-    if idx == 0:
-        return 0.0
+                         cfg: QuantileConfig) -> np.ndarray:
+    """Quantile estimate over the last axis: one histogram [n_bins] or
+    a batch [..., n_bins]. argmax(cdf >= target) is searchsorted-left
+    with a batch axis."""
+    cdf = np.cumsum(hist, axis=-1)
+    total = cdf[..., -1]
+    target = q * total
+    idx = np.argmax(cdf >= target[..., None], axis=-1)
+    idx = np.minimum(idx, cfg.n_bins - 1)
     log_lo = (idx - 1.0) * cfg.gamma_log
-    return float(cfg.min_value * math.exp(log_lo + 0.5 * cfg.gamma_log))
+    est = cfg.min_value * np.exp(log_lo + 0.5 * cfg.gamma_log)
+    return np.where((idx == 0) | (total == 0), 0.0, est)
 
 
 # ---- session state ---------------------------------------------------------
@@ -178,6 +180,11 @@ class SessionExecutor:
         # key tuple -> list[_Session], kept sorted by start
         self.sessions: dict[tuple, list[_Session]] = {}
         self._filter = QueryExecutor._extract_filter(self)  # same chain walk
+        # batch key-encoding caches (rebuildable; not snapshot state)
+        self._code_of: dict[tuple, int] = {}   # canon key -> code
+        self._code_rev: list[tuple] = []       # code -> canon key
+        self._raw_memo: dict[Any, int] = {}    # raw value(s) -> code
+        self._input_cache: dict = {}           # per-batch input columns
 
     # QueryExecutor._extract_filter reads self.node only.
 
@@ -221,6 +228,21 @@ class SessionExecutor:
             return _acc_merge(agg, acc, [float(v)])
         raise SQLCodegenError(f"session agg {agg.kind} unsupported")
 
+    # ---- vectorized batch path ---------------------------------------------
+    #
+    # SURVEY §7's session plan, realized: per-batch segmentation is
+    # numpy (lexsort by (key, ts) + gap-break detection), per-SEGMENT
+    # accumulators come from reduceat / scattered histogram updates, and
+    # only the few segments (<= touched keys x batch span / gap) walk
+    # the host merge. Merging a whole segment is exact: within a segment
+    # consecutive records are <= gap apart, so sequential per-record
+    # processing would land them all in one session chain, and every
+    # accumulator is a commutative monoid. Segments that might interact
+    # with the late-record policy (any record at ts + gap + grace <= the
+    # pre-batch watermark) take the per-record fallback, which preserves
+    # the reference's record-at-a-time drop-vs-merge decisions
+    # (SessionWindowedStream.hs:84-118).
+
     def process(self, rows: Sequence[Mapping[str, Any]],
                 ts_ms: Sequence[int]) -> list[dict[str, Any]]:
         if not rows:
@@ -228,50 +250,50 @@ class SessionExecutor:
         gap = self.window.gap_ms
         grace = self.window.grace_ms
         touched: set[tuple] = set()
-        order = sorted(range(len(rows)), key=lambda i: ts_ms[i])
-        for i in order:
-            row, ts = rows[i], int(ts_ms[i])
-            if self._filter is not None:
-                try:
-                    if not eval_host(self._filter, row):
-                        continue
-                except (TypeError, KeyError):
+        ts_all = np.asarray(ts_ms, np.int64)
+        new_wm = int(ts_all.max())
+        ts = ts_all
+        if self._filter is not None:
+            keep = np.fromiter((self._row_passes(r) for r in rows),
+                               np.bool_, len(rows))
+            if not keep.all():
+                idx = np.nonzero(keep)[0]
+                rows = [rows[i] for i in idx.tolist()]
+                ts = ts[idx]
+        n = len(rows)
+        if n:
+            codes, key_rev = self._key_codes(rows)
+            order = np.lexsort((ts, codes))
+            ks = codes[order]
+            tss = ts[order]
+            brk = np.empty(n, np.bool_)
+            brk[0] = True
+            brk[1:] = (ks[1:] != ks[:-1]) | ((tss[1:] - tss[:-1]) > gap)
+            starts = np.nonzero(brk)[0]
+            ends = np.append(starts[1:], n)
+            seg_t0 = tss[starts]
+            seg_t1 = tss[ends - 1]
+            nseg = len(starts)
+            wm = self.watermark
+            # any record possibly subject to the late policy -> per-row
+            slow = (seg_t0 + gap + grace <= wm if wm >= 0
+                    else np.zeros(nseg, np.bool_))
+            seg_of_row = np.cumsum(brk) - 1
+            accs_cols = self._segment_accs(rows, order, starts, ends,
+                                           seg_of_row)
+            seg_keys = ks[starts]
+            for j in range(nseg):
+                key = key_rev[int(seg_keys[j])]
+                if slow[j]:
+                    for i in order[starts[j]:ends[j]].tolist():
+                        if self._ingest_row(rows[i], int(ts[i])):
+                            touched.add(key)
                     continue
-            key = canon_key(tuple(row.get(c) for c in self.group_cols))
-            sess_list = self.sessions.setdefault(key, [])
-            # find sessions overlapping [ts - gap, ts + gap]
-            overl = [s for s in sess_list
-                     if s.start - gap <= ts <= s.end + gap]
-            # Late-record policy (reference merge-on-overlap,
-            # SessionWindowedStream.hs:84-118): drop only when the record
-            # is past grace AND cannot merge into any still-open session.
-            if (not overl and self.watermark >= 0
-                    and ts + gap + grace <= self.watermark):
-                continue
-            if overl:
-                merged = overl[0]
-                for s in overl[1:]:
-                    merged.end = max(merged.end, s.end)
-                    merged.start = min(merged.start, s.start)
-                    for a in self.aggs:
-                        merged.accs[a.out_name] = _acc_merge(
-                            a, merged.accs[a.out_name], s.accs[a.out_name])
-                    sess_list.remove(s)
-                merged.start = min(merged.start, ts)
-                merged.end = max(merged.end, ts)
-                target = merged
-            else:
-                target = _Session(start=ts, end=ts, accs={
-                    a.out_name: _acc_init(a, self.hll, self.qcfg)
-                    for a in self.aggs})
-                sess_list.append(target)
-                sess_list.sort(key=lambda s: s.start)
-            for a in self.aggs:
-                target.accs[a.out_name] = self._acc_update(
-                    a, target.accs[a.out_name],
-                    self._agg_input(a, row))
-            touched.add(key)
-        new_wm = max(int(t) for t in ts_ms)
+                accs = {a.out_name: accs_cols[a.out_name][j]
+                        for a in self.aggs}
+                self._merge_segment(key, int(seg_t0[j]), int(seg_t1[j]),
+                                    accs)
+                touched.add(key)
         if new_wm > self.watermark:
             self.watermark = new_wm
 
@@ -285,6 +307,225 @@ class SessionExecutor:
         out.extend(self.close_due_sessions())
         return out
 
+    def _row_passes(self, row: Mapping[str, Any]) -> bool:
+        try:
+            return bool(eval_host(self._filter, row))
+        except (TypeError, KeyError):
+            return False
+
+    # key-encoding cache bound: codes only matter WITHIN one batch, so
+    # the caches are safe to drop wholesale; bounding them keeps a
+    # months-long high-cardinality query (session per request_id) from
+    # growing without limit after its sessions closed
+    _KEY_CACHE_MAX = 1 << 18
+
+    def _key_codes(self, rows) -> tuple[np.ndarray, list]:
+        """Dense int codes per row's group key. Codes persist across
+        batches (encoding cache only — not part of snapshot state);
+        raw-value memoization keeps the per-row cost to one dict hit."""
+        if len(self._code_of) > self._KEY_CACHE_MAX:
+            self._code_of = {}
+            self._code_rev = []
+            self._raw_memo = {}
+        out = np.empty(len(rows), np.int64)
+        rev = self._code_rev
+        if len(self.group_cols) == 1:
+            c = self.group_cols[0]
+            memo = self._raw_memo
+            for i, r in enumerate(rows):
+                v = r.get(c)
+                code = memo.get(v)
+                if code is None:
+                    k = canon_key((v,))
+                    code = self._code_of.get(k)
+                    if code is None:
+                        code = len(rev)
+                        self._code_of[k] = code
+                        rev.append(k)
+                    memo[v] = code
+                out[i] = code
+        else:
+            cols = self.group_cols
+            memo = self._raw_memo
+            for i, r in enumerate(rows):
+                raw = tuple(r.get(c) for c in cols)
+                code = memo.get(raw)
+                if code is None:
+                    k = canon_key(raw)
+                    code = self._code_of.get(k)
+                    if code is None:
+                        code = len(rev)
+                        self._code_of[k] = code
+                        rev.append(k)
+                    memo[raw] = code
+                out[i] = code
+        return out, rev
+
+    def _agg_input_cols(self, a: AggSpec, rows,
+                        n: int) -> tuple[np.ndarray, np.ndarray]:
+        """(values f64[n], valid bool[n]) for one aggregate's input.
+        Invalid = missing / None / non-numeric / non-finite (the same
+        records _agg_input returns None for)."""
+        from hstream_tpu.engine.expr import Col
+
+        if a.input is None:  # _agg_input's constant-1 case
+            return np.ones(n, np.float64), np.ones(n, np.bool_)
+        # one extraction per distinct input column/expr per batch (p50 +
+        # p99 over the same column share it)
+        ck = (("col", a.input.name) if isinstance(a.input, Col)
+              else ("expr", id(a.input)))
+        hit = self._input_cache.get(ck)
+        if hit is not None:
+            return hit
+        if isinstance(a.input, Col):
+            name = a.input.name
+            raw = [r.get(name) for r in rows]
+        else:
+            raw = []
+            for r in rows:
+                try:
+                    raw.append(eval_host(a.input, r))
+                except (TypeError, KeyError):
+                    raw.append(None)
+        try:
+            vals = np.asarray(raw, np.float64)
+        except (TypeError, ValueError):
+            vals = np.array(
+                [float(v) if isinstance(v, (int, float)) else np.nan
+                 for v in raw], np.float64)
+        res = (vals, np.isfinite(vals))
+        self._input_cache[ck] = res
+        return res
+
+    def _segment_accs(self, rows, order, starts, ends,
+                      seg_of_row) -> dict[str, Any]:
+        """Per-segment accumulators (same formats _acc_init/_acc_merge
+        use), one vectorized reduction per aggregate."""
+        nseg = len(starts)
+        out: dict[str, Any] = {}
+        seg_len = None
+        self._input_cache: dict = {}
+        for a in self.aggs:
+            if a.kind == AggKind.COUNT_ALL:
+                if seg_len is None:
+                    seg_len = (ends - starts).astype(np.int64)
+                out[a.out_name] = seg_len.tolist()
+                continue
+            vals, valid = self._agg_input_cols(a, rows, len(order))
+            vs = vals[order]
+            ok = valid[order]
+            if a.kind == AggKind.COUNT:
+                out[a.out_name] = np.add.reduceat(
+                    ok.astype(np.int64), starts).tolist()
+            elif a.kind == AggKind.SUM:
+                out[a.out_name] = np.add.reduceat(
+                    np.where(ok, vs, 0.0), starts).tolist()
+            elif a.kind == AggKind.AVG:
+                s = np.add.reduceat(np.where(ok, vs, 0.0), starts)
+                c = np.add.reduceat(ok.astype(np.int64), starts)
+                out[a.out_name] = list(zip(s.tolist(), c.tolist()))
+            elif a.kind == AggKind.MIN:
+                out[a.out_name] = np.minimum.reduceat(
+                    np.where(ok, vs, np.inf), starts).tolist()
+            elif a.kind == AggKind.MAX:
+                out[a.out_name] = np.maximum.reduceat(
+                    np.where(ok, vs, -np.inf), starts).tolist()
+            elif a.kind == AggKind.APPROX_QUANTILE:
+                hist = np.zeros((nseg, self.qcfg.n_bins), np.int64)
+                b = quantile_bin_np(np.where(ok, vs, self.qcfg.min_value),
+                                    self.qcfg)
+                np.add.at(hist, (seg_of_row[ok], b[ok]), 1)
+                out[a.out_name] = hist
+            elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
+                regs = np.zeros((nseg, self.hll.m), np.int8)
+                reg, rank = hll_update_np(
+                    np.where(ok, vs, 0.0).astype(np.float32), self.hll)
+                np.maximum.at(regs, (seg_of_row[ok], reg[ok]), rank[ok])
+                out[a.out_name] = regs
+            elif a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                from hstream_tpu.engine.lattice import agg_width
+
+                k = agg_width(a)
+                lst = []
+                for j in range(nseg):
+                    sv = vs[starts[j]:ends[j]][ok[starts[j]:ends[j]]]
+                    if a.kind == AggKind.TOPK_DISTINCT:
+                        sv = np.unique(sv)
+                    sv = np.sort(sv)[::-1][:k]
+                    lst.append([float(x) for x in sv])
+                out[a.out_name] = lst
+            else:
+                raise SQLCodegenError(
+                    f"session agg {a.kind} unsupported")
+        return out
+
+    def _merge_segment(self, key: tuple, t0: int, t1: int,
+                       accs: dict[str, Any]) -> None:
+        gap = self.window.gap_ms
+        sess_list = self.sessions.setdefault(key, [])
+        overl = [s for s in sess_list
+                 if s.start - gap <= t1 and t0 <= s.end + gap]
+        if not overl:
+            # copy array accs: segment rows are views into batch-wide
+            # reduction buffers and must not pin them in session state
+            own = {k: (v.copy() if isinstance(v, np.ndarray) else v)
+                   for k, v in accs.items()}
+            sess_list.append(_Session(start=t0, end=t1, accs=own))
+            sess_list.sort(key=lambda s: s.start)
+            return
+        m = overl[0]
+        for s in overl[1:]:
+            m.start = min(m.start, s.start)
+            m.end = max(m.end, s.end)
+            for a in self.aggs:
+                m.accs[a.out_name] = _acc_merge(
+                    a, m.accs[a.out_name], s.accs[a.out_name])
+            sess_list.remove(s)
+        m.start = min(m.start, t0)
+        m.end = max(m.end, t1)
+        for a in self.aggs:
+            m.accs[a.out_name] = _acc_merge(
+                a, m.accs[a.out_name], accs[a.out_name])
+
+    def _ingest_row(self, row: Mapping[str, Any], ts: int) -> bool:
+        """Exact per-record path (late-policy segments): returns True
+        when the record landed in a session, False when dropped."""
+        gap = self.window.gap_ms
+        grace = self.window.grace_ms
+        key = canon_key(tuple(row.get(c) for c in self.group_cols))
+        sess_list = self.sessions.setdefault(key, [])
+        overl = [s for s in sess_list
+                 if s.start - gap <= ts <= s.end + gap]
+        # Late-record policy (reference merge-on-overlap,
+        # SessionWindowedStream.hs:84-118): drop only when the record
+        # is past grace AND cannot merge into any still-open session.
+        if (not overl and self.watermark >= 0
+                and ts + gap + grace <= self.watermark):
+            return False
+        if overl:
+            merged = overl[0]
+            for s in overl[1:]:
+                merged.end = max(merged.end, s.end)
+                merged.start = min(merged.start, s.start)
+                for a in self.aggs:
+                    merged.accs[a.out_name] = _acc_merge(
+                        a, merged.accs[a.out_name], s.accs[a.out_name])
+                sess_list.remove(s)
+            merged.start = min(merged.start, ts)
+            merged.end = max(merged.end, ts)
+            target = merged
+        else:
+            target = _Session(start=ts, end=ts, accs={
+                a.out_name: _acc_init(a, self.hll, self.qcfg)
+                for a in self.aggs})
+            sess_list.append(target)
+            sess_list.sort(key=lambda s: s.start)
+        for a in self.aggs:
+            target.accs[a.out_name] = self._acc_update(
+                a, target.accs[a.out_name],
+                self._agg_input(a, row))
+        return True
+
     def close_due_sessions(self) -> list[dict[str, Any]]:
         # A session may only close once no acceptable future record can
         # still merge into it. Acceptable records have ts > wm-gap-grace
@@ -294,17 +535,62 @@ class SessionExecutor:
         # (SessionWindowedStream.hs:84-118); closing one gap-width later
         # preserves its merge-on-overlap semantics while still emitting.
         gap, grace = self.window.gap_ms, self.window.grace_ms
-        rows = []
+        pairs: list[tuple[tuple, _Session]] = []
         for key, sess_list in list(self.sessions.items()):
             due = [s for s in sess_list
                    if s.end + 2 * gap + grace <= self.watermark]
             for s in due:
                 if not self.emit_changes:
-                    rows.append(self._emit_row(key, s))
+                    pairs.append((key, s))
                 sess_list.remove(s)
             if not sess_list:
                 del self.sessions[key]
-        return [r for r in rows if r is not None]
+        return self._emit_rows_batch(pairs)
+
+    def _emit_rows_batch(self, pairs: list) -> list[dict[str, Any]]:
+        """Emit many sessions at once: sketch finalization (quantile
+        cdf + DDSketch bin edge, HLL estimate) runs vectorized over the
+        whole close set instead of ~10 numpy calls per row."""
+        if not pairs:
+            return []
+        vec: dict[str, np.ndarray] = {}
+        for a in self.aggs:
+            if a.kind == AggKind.APPROX_QUANTILE:
+                hist = np.stack([s.accs[a.out_name] for _, s in pairs])
+                vec[a.out_name] = quantile_estimate_np(
+                    hist, a.quantile or 0.5, self.qcfg)
+            elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
+                regs = np.stack([s.accs[a.out_name] for _, s in pairs])
+                vec[a.out_name] = np.rint(
+                    hll_estimate_np(regs, self.hll)).astype(np.int64)
+        rows = []
+        for i, (key, s) in enumerate(pairs):
+            row = dict(zip(self.group_cols, key))
+            for a in self.aggs:
+                v = vec.get(a.out_name)
+                if v is None:
+                    row[a.out_name] = self._finalize(a, s.accs[a.out_name])
+                elif a.kind == AggKind.APPROX_QUANTILE:
+                    row[a.out_name] = float(v[i])
+                else:
+                    row[a.out_name] = int(v[i])
+            row["winStart"] = s.start
+            row["winEnd"] = s.end + self.window.gap_ms
+            if self.node.having is not None:
+                try:
+                    if not eval_host(self.node.having, row):
+                        continue
+                except (TypeError, KeyError):
+                    continue
+            if self.node.post_projections:
+                proj = {}
+                for name, expr in self.node.post_projections:
+                    proj[name] = eval_host(expr, row)
+                for meta in ("winStart", "winEnd"):
+                    proj[meta] = row[meta]
+                row = proj
+            rows.append(row)
+        return rows
 
     def _finalize(self, agg: AggSpec, acc):
         if agg.kind == AggKind.AVG:
@@ -314,9 +600,10 @@ class SessionExecutor:
         if agg.kind == AggKind.MAX:
             return 0.0 if acc == -math.inf else acc
         if agg.kind == AggKind.APPROX_COUNT_DISTINCT:
-            return int(round(hll_estimate_np(acc, self.hll)))
+            return int(np.rint(hll_estimate_np(acc, self.hll)))
         if agg.kind == AggKind.APPROX_QUANTILE:
-            return quantile_estimate_np(acc, agg.quantile or 0.5, self.qcfg)
+            return float(quantile_estimate_np(acc, agg.quantile or 0.5,
+                                              self.qcfg))
         if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
             return list(acc)
         return acc

@@ -717,10 +717,14 @@ class HStreamApiServicer:
         reader.stop_reading(logid)
         reader.start_reading(logid, max(tail - 4, lo), tail)
         fields: set[str] = set()
-        sampled = False
-        for item in head + reader.read(64):
+
+        def collect(item) -> bool:
+            """Union item's record fields into `fields`; True if any
+            record was decodable (one shared walk for the sample pass
+            and the widen pass)."""
+            any_dec = False
             if not isinstance(item, DataBatch):
-                continue
+                return False
             for payload in item.payloads:
                 r = rec.parse_record(payload)
                 if (r.header.flag == rec.pb.RECORD_FLAG_RAW
@@ -729,13 +733,18 @@ class HStreamApiServicer:
                         _, cols = columnar.decode_columnar(r.payload)
                     except Exception:  # noqa: BLE001
                         continue
-                    fields |= set(cols)
-                    sampled = True
+                    fields.update(cols)
+                    any_dec = True
                 else:
                     d = rec.record_to_dict(r)
                     if d is not None:
-                        fields |= set(d)
-                        sampled = True
+                        fields.update(d)
+                        any_dec = True
+            return any_dec
+
+        sampled = False
+        for item in head + reader.read(64):
+            sampled |= collect(item)
         missing = referenced - fields
         if sampled and missing:
             # widen before rejecting: a heterogeneous stream may carry
@@ -743,21 +752,7 @@ class HStreamApiServicer:
             reader.stop_reading(logid)
             reader.start_reading(logid, lo, tail)
             for item in reader.read(512):
-                if not isinstance(item, DataBatch):
-                    continue
-                for payload in item.payloads:
-                    r = rec.parse_record(payload)
-                    if (r.header.flag == rec.pb.RECORD_FLAG_RAW
-                            and columnar.is_columnar(r.payload)):
-                        try:
-                            _, cols = columnar.decode_columnar(r.payload)
-                        except Exception:  # noqa: BLE001
-                            continue
-                        fields |= set(cols)
-                    else:
-                        d = rec.record_to_dict(r)
-                        if d is not None:
-                            fields |= set(d)
+                collect(item)
                 missing = referenced - fields
                 if not missing:
                     break
