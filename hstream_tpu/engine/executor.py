@@ -191,6 +191,10 @@ class QueryExecutor:
         # Changes then lag emission by one micro-batch; flush_changes()
         # drains the tail.
         self.defer_change_decode = False
+        # how many change extracts may queue before a batched fetch; >1
+        # amortizes the device->host round trip over many micro-batches
+        # (changelog rows then lag ingest by up to `depth` batches)
+        self.change_drain_depth = 1
         self._pending_changes: list[Any] = []
 
     def _extract_filter(self) -> Expr | None:
@@ -769,18 +773,39 @@ class QueryExecutor:
         # the epoch is captured WITH the extract: a rebase between
         # extract and the deferred decode must not shift window bounds
         self._pending_changes.append((self.epoch, packed))
-        rows: list[dict[str, Any]] = []
-        while len(self._pending_changes) > 1:
-            epoch, buf = self._pending_changes.pop(0)
-            rows.extend(self._decode_changes(np.asarray(buf), epoch))
+        if len(self._pending_changes) <= max(self.change_drain_depth, 1):
+            return []
+        # keep the newest extract deferred (it pipelines behind the
+        # next batch's work); fetch everything older in one transfer
+        keep = self._pending_changes.pop()
+        rows = self._decode_pending(self._pending_changes)
+        self._pending_changes = [keep]
         return rows
 
     def flush_changes(self) -> list[dict[str, Any]]:
         """Decode every deferred changelog extract (forces the queue)."""
+        rows = self._decode_pending(self._pending_changes)
+        self._pending_changes = []
+        return rows
+
+    def _decode_pending(self, pending: list) -> list[dict[str, Any]]:
+        """Decode deferred change extracts, fetching device buffers in
+        ONE device->host transfer per buffer shape (fetch count, not
+        bytes, dominates on real links — each np.asarray is a full
+        round trip). Shapes differ only across grow_keys boundaries."""
+        if not pending:
+            return []
+        if len(pending) == 1:
+            epoch, buf = pending[0]
+            return self._decode_changes(np.asarray(buf), epoch)
         rows: list[dict[str, Any]] = []
-        while self._pending_changes:
-            epoch, buf = self._pending_changes.pop(0)
-            rows.extend(self._decode_changes(np.asarray(buf), epoch))
+        by_shape: dict[tuple, list] = {}
+        for ep, buf in pending:
+            by_shape.setdefault(tuple(buf.shape), []).append((ep, buf))
+        for group in by_shape.values():
+            stacked = np.asarray(jnp.stack([b for _, b in group]))
+            for (ep, _), buf in zip(group, stacked):
+                rows.extend(self._decode_changes(buf, ep))
         return rows
 
     def _decode_changes(self, packed: np.ndarray,

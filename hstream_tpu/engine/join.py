@@ -22,10 +22,12 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import Any, Mapping, Sequence
 
+import numpy as np
+
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
-from hstream_tpu.engine.statestore import LastValueStore, TimestampedKVStore
+from hstream_tpu.engine.statestore import LastValueStore
 from hstream_tpu.engine.types import canon_key
 from hstream_tpu.engine.window import DEFAULT_GRACE_MS
 
@@ -97,11 +99,6 @@ def split_on_condition(on: Expr, left_streams: set[str],
             lks.append(strip(b))
             rks.append(strip(a))
     return lks, rks
-
-
-# the interval join's side stores ARE the reference's TimestampedKVStore
-# shape; one shared implementation lives in engine.statestore
-_SideStore = TimestampedKVStore
 
 
 class _JoinBase:
@@ -252,6 +249,107 @@ class TableJoinExecutor(_JoinBase):
         return self._inner_process(joined, jts)
 
 
+class _FlatIntervalStore:
+    """One side of the interval join as flat sorted arrays.
+
+    Rows live in arrays sorted by a composite (key code, ts) int64 —
+    code * 2^41 + (ts - t0) — so a WHOLE batch probes with one
+    searchsorted pair and inserts with one np.insert: no per-key Python.
+    The reference walks a per-record ordered map instead
+    (Processing/Store.hs tksPut/tksRange); this is that store's batch
+    restatement. Key codes are dense ints owned by the executor
+    (shared across both sides so probes and inserts agree).
+    """
+
+    TS_BITS = 41                     # ~69 years of ms offsets
+    SPAN = 1 << TS_BITS
+
+    def __init__(self, key_rev: list):
+        self.code = np.empty(0, np.int64)
+        self.ts = np.empty(0, np.int64)
+        self.comp = np.empty(0, np.int64)
+        self.rows = np.empty(0, object)
+        self.t0: int | None = None
+        self.key_rev = key_rev       # shared code -> canon key (executor)
+
+    def __len__(self) -> int:
+        return len(self.code)
+
+    def _rebase(self, t0: int) -> None:
+        self.t0 = t0
+        self.comp = self.code * self.SPAN + (self.ts - t0)
+
+    def insert_sorted(self, code: np.ndarray, ts: np.ndarray,
+                      rows: np.ndarray) -> None:
+        """Insert a batch already sorted by (code, ts)."""
+        if len(code) == 0:
+            return
+        mn = int(ts.min())
+        new_t0 = mn if self.t0 is None else min(mn, self.t0)
+        hi = int(ts.max())
+        if len(self.ts):
+            hi = max(hi, int(self.ts.max()))
+        if hi - new_t0 >= self.SPAN:
+            # an offset past 2^41 ms (~69 years) would overflow into a
+            # neighboring code's composite range and silently corrupt
+            # probes — loud failure beats wrong join results. Checked
+            # over existing AND incoming rows: a rebase to an older t0
+            # shifts every resident row's offset too.
+            raise SQLCodegenError(
+                "join record timestamps span more than 2^41 ms; "
+                "timestamps must be epoch milliseconds")
+        if self.t0 is None or new_t0 < self.t0:
+            self._rebase(new_t0)
+        bcomp = code * self.SPAN + (ts - self.t0)
+        if len(self.comp) == 0:
+            self.code, self.ts, self.comp = code, ts, bcomp
+            self.rows = rows
+            return
+        idx = np.searchsorted(self.comp, bcomp)
+        self.code = np.insert(self.code, idx, code)
+        self.ts = np.insert(self.ts, idx, ts)
+        self.comp = np.insert(self.comp, idx, bcomp)
+        self.rows = np.insert(self.rows, idx, rows)
+
+    def probe(self, code: np.ndarray, lo_ts: np.ndarray,
+              hi_ts: np.ndarray) -> tuple[np.ndarray, np.ndarray] | None:
+        """Per query i: [start, end) indices of rows with this code and
+        lo_ts[i] <= ts <= hi_ts[i]."""
+        if len(self.comp) == 0:
+            return None
+        lo = np.clip(lo_ts - self.t0, 0, self.SPAN - 1)
+        hi = np.clip(hi_ts - self.t0, -1, self.SPAN - 1)
+        lo_i = np.searchsorted(self.comp, code * self.SPAN + lo, "left")
+        hi_i = np.searchsorted(self.comp, code * self.SPAN + hi, "right")
+        return lo_i, np.maximum(hi_i, lo_i)
+
+    def prune(self, min_ts: int) -> None:
+        keep = self.ts >= min_ts
+        if not keep.all():
+            self.code = self.code[keep]
+            self.ts = self.ts[keep]
+            self.comp = self.comp[keep]
+            self.rows = self.rows[keep]
+
+    def remap_codes(self, new_of_old: np.ndarray) -> None:
+        """Apply a code compaction (sorted-order-preserving)."""
+        self.code = new_of_old[self.code]
+        if self.t0 is not None:
+            self.comp = self.code * self.SPAN + (self.ts - self.t0)
+
+    @property
+    def by_key(self) -> dict:
+        """key tuple -> (ts list, rows list) view (snapshots; same shape
+        TimestampedKVStore exposes, so the blob format is unchanged)."""
+        out: dict[tuple, tuple[list, list]] = {}
+        for i in range(len(self.code)):
+            key = self.key_rev[int(self.code[i])]
+            tss, rows = out.setdefault(key, ([], []))
+            tss.append(int(self.ts[i]))
+            rows.append(self.rows[i])
+        return out
+
+
 class JoinExecutor(_JoinBase):
     """Executes `SELECT ... FROM l [INNER|LEFT] JOIN r WITHIN(...) ON ...`.
 
@@ -277,10 +375,37 @@ class JoinExecutor(_JoinBase):
             grace = node.window.grace_ms
         self.retention_ms = self.within + grace
 
-        self._stores = {"l": _SideStore(), "r": _SideStore()}
+        # shared join-key code space across both sides
+        self._jcode: dict[tuple, int] = {}
+        self._jcode_rev: list[tuple] = []
+        self._kid_lut = np.full(1024, -1, np.int32)  # code -> inner key id
+        self._stores = {"l": _FlatIntervalStore(self._jcode_rev),
+                        "r": _FlatIntervalStore(self._jcode_rev)}
         self.watermark: int = -1
+        # fast-path plumbing (computed lazily once the inner executor
+        # and both sides' observed fields exist)
+        self._fields = {"l": set(), "r": set()}
+        self._fast: dict | None = None   # None = unknown yet
+        # opt-in: accumulate this many matched rows before stepping the
+        # inner executor — on a real link every step dispatch pays a
+        # round trip, so small probe batches must coalesce (the same
+        # lever as the ingest pipeline's staged caps). Emission then
+        # lags by the coalesce horizon; callers flush via flush_staged.
+        self.coalesce_rows = 0
+        self._staged: list[tuple] = []   # (key_ids, jts, cols, nulls)
+        self._staged_n = 0
 
     # ---- ingest ------------------------------------------------------------
+    #
+    # Batched: the per-record reference loop (insert my side, probe the
+    # other side over [ts-within, ts+within], Stream.hs:238-300) is
+    # restated as: group the batch by join key, batch-append each group
+    # to my side's store, then probe the other side with ONE
+    # searchsorted pair per group (the other side never changes during
+    # the batch, so insert/probe need no interleaving). Matched pairs
+    # feed the inner aggregate COLUMNAR (key ids broadcast per group
+    # when the GROUP BY key is the join key) — no joined-row dicts on
+    # the steady path.
 
     def process(self, rows: Sequence[Mapping[str, Any]],
                 ts_ms: Sequence[int], stream: str | None = None
@@ -289,22 +414,50 @@ class JoinExecutor(_JoinBase):
         mine = self._stores[side]
         other = self._stores["r" if side == "l" else "l"]
         my_keys = self.left_keys if side == "l" else self.right_keys
-        joined: list[dict[str, Any]] = []
-        jts: list[int] = []
-        for row, ts in zip(rows, ts_ms):
-            ts = int(ts)
-            key = self._key(my_keys, row)
-            if key is None:
-                continue
-            mine.put(key, ts, dict(row))
-            for ots, orow in other.range(key, ts - self.within,
-                                         ts + self.within):
-                if side == "l":
-                    jrow = self._joined_row(row, orow)
-                else:
-                    jrow = self._joined_row(orow, row)
-                joined.append(jrow)
-                jts.append(max(ts, ots))
+        n = len(rows)
+        out: list[dict[str, Any]] = []
+        if n:
+            if rows[0]:
+                self._fields[side].update(rows[0])
+            ts = np.asarray(ts_ms, np.int64)
+            codes = self._batch_codes(my_keys, rows)       # -1 = no key
+            keep = codes >= 0
+            if not keep.all():
+                kidx = np.nonzero(keep)[0]
+                codes = codes[kidx]
+                bts = ts[kidx]
+                brows = np.asarray([dict(rows[i]) for i in kidx.tolist()],
+                                   object)
+            else:
+                bts = ts
+                brows = np.empty(n, object)
+                for i, r in enumerate(rows):
+                    brows[i] = dict(r)
+            if len(codes):
+                order = np.lexsort((bts, codes))
+                codes = codes[order]
+                bts = bts[order]
+                brows = brows[order]
+                # probe the other side BEFORE inserting: the reference
+                # loop probes only the opposite store, which this batch
+                # never mutates, so insert/probe need no interleaving
+                pr = other.probe(codes, bts - self.within,
+                                 bts + self.within)
+                mine.insert_sorted(codes, bts, brows)
+                if pr is not None:
+                    lo_i, hi_i = pr
+                    cnt = hi_i - lo_i
+                    tot = int(cnt.sum())
+                    if tot:
+                        start = np.cumsum(cnt) - cnt
+                        oidx = (np.arange(tot, dtype=np.int64)
+                                - np.repeat(start, cnt)
+                                + np.repeat(lo_i, cnt))
+                        rep = np.repeat(np.arange(len(codes)), cnt)
+                        jts = np.maximum(bts[rep], other.ts[oidx])
+                        out = self._emit_matches(
+                            side, brows, rep, codes[rep], other, oidx,
+                            jts)
         new_wm = max((int(t) for t in ts_ms), default=self.watermark)
         if new_wm > self.watermark:
             self.watermark = new_wm
@@ -312,7 +465,292 @@ class JoinExecutor(_JoinBase):
             if cutoff > 0:
                 mine.prune(cutoff)
                 other.prune(cutoff)
-        if not joined:
+        return out
+
+    def _batch_codes(self, my_keys, rows) -> np.ndarray:
+        """Dense join-key code per row (-1 = null key, skipped). One
+        shared code space for both sides; compacted when it outgrows
+        the composite-key budget."""
+        # compact BEFORE encoding so this batch's fresh keys get live
+        # codes (compacting afterwards would remap them to -1 and drop
+        # the rows)
+        if len(self._jcode_rev) + len(rows) >= (1 << 22) - 1:
+            self._compact_codes()
+            if len(self._jcode_rev) + len(rows) >= (1 << 22) - 1:
+                raise SQLCodegenError(
+                    "join key cardinality within the retention window "
+                    f"exceeds {1 << 22} distinct keys")
+        jcode = self._jcode
+        rev = self._jcode_rev
+        out = np.empty(len(rows), np.int64)
+
+        def code_of(k) -> int:
+            c = jcode.get(k)
+            if c is None:
+                c = len(rev)
+                jcode[k] = c
+                rev.append(k)
+            return c
+
+        if all(isinstance(e, Col) for e in my_keys):
+            names = [e.name for e in my_keys]
+            if len(names) == 1:
+                nm = names[0]
+                for i, r in enumerate(rows):
+                    v = r.get(nm)
+                    out[i] = -1 if v is None else code_of(canon_key((v,)))
+            else:
+                for i, r in enumerate(rows):
+                    vals = tuple(r.get(c) for c in names)
+                    out[i] = (-1 if any(v is None for v in vals)
+                              else code_of(canon_key(vals)))
+        else:
+            for i, r in enumerate(rows):
+                k = self._key(my_keys, r)
+                out[i] = -1 if k is None else code_of(k)
+        return out
+
+    def _compact_codes(self) -> None:
+        """Code-space compaction: keep only codes still live in either
+        store (retention bounds them), reassign dense codes in sorted
+        order (store order is preserved), remap stores + lut + dict."""
+        live = np.union1d(self._stores["l"].code, self._stores["r"].code)
+        new_of_old = np.full(len(self._jcode_rev), -1, np.int64)
+        new_of_old[live] = np.arange(len(live))
+        for st in self._stores.values():
+            st.remap_codes(new_of_old)
+        new_rev = [self._jcode_rev[int(c)] for c in live.tolist()]
+        self._jcode.clear()
+        self._jcode.update({k: i for i, k in enumerate(new_rev)})
+        self._jcode_rev[:] = new_rev      # in place: stores share it
+        lut = np.full(max(len(new_rev), 1024), -1, np.int32)
+        old_lut = self._kid_lut
+        for new_c, old_c in enumerate(live.tolist()):
+            if old_c < len(old_lut):
+                lut[new_c] = old_lut[old_c]
+        self._kid_lut = lut
+
+    # ---- match emission ----------------------------------------------------
+
+    def _emit_matches(self, side, brows, rep, mcodes, other, oidx,
+                      jts) -> list[dict[str, Any]]:
+        fast = self._fast_info()
+        if fast is not None:
+            key_ids = self._match_key_ids(mcodes)
+            cols, nulls = self._match_cols(fast, side, brows, rep,
+                                           other, oidx)
+            if self.coalesce_rows > 0:
+                self._staged.append((key_ids, jts, cols, nulls))
+                self._staged_n += len(key_ids)
+                if self._staged_n < self.coalesce_rows:
+                    return []
+                return self._drain_staged(keep_tail=True)
+            return self._inner.process_columnar(key_ids, jts, cols,
+                                                nulls)
+        # general path: materialize joined-row dicts (also the sample
+        # source for the inner executor's construction)
+        orows = other.rows[oidx]
+        joined: list[dict[str, Any]] = []
+        for i in range(len(rep)):
+            row, orow = brows[rep[i]], orows[i]
+            joined.append(self._joined_row(row, orow) if side == "l"
+                          else self._joined_row(orow, row))
+        res = self._inner_process(joined, jts.tolist())
+        # re-plan while disabled: a field observed on a later batch can
+        # make a previously-unresolvable column resolvable
+        if not self._fast:
+            self._plan_fast()
+        return res
+
+    def _match_key_ids(self, mcodes: np.ndarray) -> np.ndarray:
+        """Inner-executor key ids per match via a code-indexed LUT (the
+        GROUP BY key IS the join key on this path)."""
+        lut = self._kid_lut
+        if len(lut) < len(self._jcode_rev):
+            grown = np.full(max(len(self._jcode_rev), 2 * len(lut)),
+                            -1, np.int32)
+            grown[:len(lut)] = lut
+            self._kid_lut = lut = grown
+        need = np.unique(mcodes[lut[mcodes] < 0])
+        for c in need.tolist():
+            lut[c] = self._inner.key_id_for(self._jcode_rev[c])
+        return lut[mcodes]
+
+    def flush_staged(self) -> list[dict[str, Any]]:
+        """Step the inner executor with every coalesced match row."""
+        return self._drain_staged(keep_tail=False)
+
+    def _drain_staged(self, *, keep_tail: bool) -> list[dict[str, Any]]:
+        """Step coalesced matches. keep_tail=True steps only whole
+        inner-batch-capacity chunks and re-stages the remainder, so the
+        steady state reuses ONE compiled step shape (each distinct
+        padded cap is a separate XLA compile)."""
+        if not self._staged:
             return []
-        return self._inner_process(joined, jts)
+        staged, self._staged = self._staged, []
+        self._staged_n = 0
+        key_ids = np.concatenate([s[0] for s in staged])
+        jts = np.concatenate([s[1] for s in staged])
+        names = staged[0][2].keys()
+        cols = {c: np.concatenate([s[2][c] for s in staged])
+                for c in names}
+        nulls = None
+        if any(s[3] for s in staged):
+            nulls = {}
+            for c in names:
+                parts = [s[3][c] if (s[3] and c in s[3])
+                         else np.zeros(len(s[0]), np.bool_)
+                         for s in staged]
+                m = np.concatenate(parts)
+                if m.any():
+                    nulls[c] = m
+            nulls = nulls or None
+        n = len(key_ids)
+        cap = self._inner.batch_capacity
+        cut = n - (n % cap) if keep_tail else n
+        if keep_tail and cut < n:
+            tail_nulls = (None if nulls is None else
+                          {c: m[cut:] for c, m in nulls.items()})
+            self._staged.append((key_ids[cut:], jts[cut:],
+                                 {c: v[cut:] for c, v in cols.items()},
+                                 tail_nulls))
+            self._staged_n = n - cut
+        if cut == 0:
+            return []
+        head_nulls = (None if nulls is None else
+                      {c: m[:cut] for c, m in nulls.items()})
+        return self._inner.process_columnar(
+            key_ids[:cut], jts[:cut],
+            {c: v[:cut] for c, v in cols.items()}, head_nulls)
+
+    def _fast_info(self) -> dict | None:
+        if self._fast is None and self._inner is not None:
+            self._plan_fast()
+        return self._fast if isinstance(self._fast, dict) else None
+
+    def _resolve_col(self, name: str) -> tuple[str, str] | None:
+        """Joined-row column name -> (side, source column): qualified
+        names split on the alias; bare names take left precedence, the
+        same rule _joined_row applies."""
+        if "." in name:
+            pre, col = name.split(".", 1)
+            s = self._aliases.get(pre)
+            if s is not None:
+                return s, col
+        if name in self._fields["l"]:
+            return "l", name
+        if name in self._fields["r"]:
+            return "r", name
+        return None
+
+    def close_due_windows(self) -> list[dict[str, Any]]:
+        rows = self.flush_staged() if self._staged else []
+        rows.extend(super().close_due_windows())
+        return rows
+
+    def _plan_fast(self) -> None:
+        """Enable the columnar match path when (a) the inner executor
+        has one, (b) its GROUP BY columns are exactly the join key (so
+        inner key ids broadcast per probe group), and (c) every column
+        the inner step needs resolves to one side."""
+        inner = self._inner
+        self._fast = False
+        if inner is None or not hasattr(inner, "process_columnar"):
+            return
+        # after a snapshot restore the observed-field sets are empty;
+        # reseed them from any stored row so bare names still resolve
+        for s in ("l", "r"):
+            if not self._fields[s] and len(self._stores[s]):
+                self._fields[s].update(self._stores[s].rows[0])
+        knames_l = ([e.name for e in self.left_keys]
+                    if all(isinstance(e, Col) for e in self.left_keys)
+                    else None)
+        knames_r = ([e.name for e in self.right_keys]
+                    if all(isinstance(e, Col) for e in self.right_keys)
+                    else None)
+        resolved = [self._resolve_col(c) for c in inner.group_cols]
+        if any(r is None for r in resolved):
+            return
+        gs = [s for s, _ in resolved]
+        gcols = [c for _, c in resolved]
+        if not (len(set(gs)) == 1
+                and ((gs[0] == "l" and gcols == knames_l)
+                     or (gs[0] == "r" and gcols == knames_r))):
+            return
+        need = {}
+        for name in inner._needed_cols:
+            if "." in name:
+                pre, col = name.split(".", 1)
+                s = self._aliases.get(pre)
+                if s is not None:
+                    need[name] = (s, col)
+                    continue
+            if (name in self._fields["l"]
+                    or name in self._fields["r"]):
+                # bare name: gather per match row with _joined_row's
+                # left-precedence (observation can't tell which side a
+                # heterogeneous stream carries the field on)
+                need[name] = ("both", name)
+            else:
+                return
+        self._fast = {"need": need}
+
+    def _match_cols(self, fast, side, brows, rep, other,
+                    oidx) -> tuple[dict, dict | None]:
+        """Columns the inner step needs, gathered straight from the
+        matched source rows (no joined dicts)."""
+        from hstream_tpu.engine.types import ColumnType
+
+        inner = self._inner
+        tot = len(rep)
+        cols: dict[str, np.ndarray] = {}
+        nulls: dict[str, np.ndarray] = {}
+        src_cache: dict[tuple, list] = {}
+        _MISS = object()
+        for name, (cside, col) in fast["need"].items():
+            vals = src_cache.get((cside, col))
+            if vals is None:
+                if cside == "both":
+                    # left-precedence bare name, decided per match row
+                    lrows, lidx = ((brows, rep) if side == "l"
+                                   else (other.rows, oidx))
+                    rrows, ridx = ((other.rows, oidx) if side == "l"
+                                   else (brows, rep))
+                    vals = []
+                    for li, ri in zip(lidx.tolist(), ridx.tolist()):
+                        v = lrows[li].get(col, _MISS)
+                        if v is _MISS:
+                            v = rrows[ri].get(col)
+                        vals.append(v)
+                elif cside == side:
+                    vals = [brows[i].get(col) for i in rep.tolist()]
+                else:
+                    vals = [other.rows[j].get(col)
+                            for j in oidx.tolist()]
+                src_cache[(cside, col)] = vals
+            want = inner.schema.type_of(name)
+            msk = np.zeros(tot, np.bool_)
+            if want == ColumnType.STRING:
+                enc = inner.dicts[name].encode
+                arr = np.empty(tot, np.int32)
+                for i, v in enumerate(vals):
+                    if v is None:
+                        arr[i] = -1
+                        msk[i] = True
+                    else:
+                        arr[i] = enc(str(v))
+            else:
+                dt = (np.bool_ if want == ColumnType.BOOL
+                      else np.int32 if want == ColumnType.INT
+                      else np.float32)
+                arr = np.zeros(tot, dt)
+                for i, v in enumerate(vals):
+                    if v is None or not isinstance(v, (int, float, bool)):
+                        msk[i] = True
+                    else:
+                        arr[i] = v
+            cols[name] = arr
+            if msk.any():
+                nulls[name] = msk
+        return cols, (nulls or None)
 

@@ -195,7 +195,12 @@ class SessionExecutor:
             v = eval_host(agg.input, row)
         except (TypeError, KeyError):
             return None
-        if v is None or (isinstance(v, float) and not math.isfinite(v)):
+        # non-numeric values are NULL, the same rule the vectorized
+        # path's _agg_input_cols applies — lateness must not change
+        # whether a malformed record is skipped or crashes the query
+        if not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float) and not math.isfinite(v):
             return None
         return v
 
@@ -565,31 +570,12 @@ class SessionExecutor:
                     hll_estimate_np(regs, self.hll)).astype(np.int64)
         rows = []
         for i, (key, s) in enumerate(pairs):
-            row = dict(zip(self.group_cols, key))
-            for a in self.aggs:
-                v = vec.get(a.out_name)
-                if v is None:
-                    row[a.out_name] = self._finalize(a, s.accs[a.out_name])
-                elif a.kind == AggKind.APPROX_QUANTILE:
-                    row[a.out_name] = float(v[i])
-                else:
-                    row[a.out_name] = int(v[i])
-            row["winStart"] = s.start
-            row["winEnd"] = s.end + self.window.gap_ms
-            if self.node.having is not None:
-                try:
-                    if not eval_host(self.node.having, row):
-                        continue
-                except (TypeError, KeyError):
-                    continue
-            if self.node.post_projections:
-                proj = {}
-                for name, expr in self.node.post_projections:
-                    proj[name] = eval_host(expr, row)
-                for meta in ("winStart", "winEnd"):
-                    proj[meta] = row[meta]
-                row = proj
-            rows.append(row)
+            overrides = {
+                name: (float(v[i]) if v.dtype.kind == "f" else int(v[i]))
+                for name, v in vec.items()}
+            r = self._emit_row(key, s, overrides or None)
+            if r is not None:
+                rows.append(r)
         return rows
 
     def _finalize(self, agg: AggSpec, acc):
@@ -608,10 +594,18 @@ class SessionExecutor:
             return list(acc)
         return acc
 
-    def _emit_row(self, key: tuple, s: _Session) -> dict[str, Any] | None:
+    def _emit_row(self, key: tuple, s: _Session,
+                  overrides: dict[str, Any] | None = None
+                  ) -> dict[str, Any] | None:
+        """One emitted row. `overrides` carries pre-finalized aggregate
+        values (the batched sketch finalization) so the close path and
+        this path share the HAVING/projection/window-stamp tail."""
         row = dict(zip(self.group_cols, key))
         for a in self.aggs:
-            row[a.out_name] = self._finalize(a, s.accs[a.out_name])
+            if overrides is not None and a.out_name in overrides:
+                row[a.out_name] = overrides[a.out_name]
+            else:
+                row[a.out_name] = self._finalize(a, s.accs[a.out_name])
         row["winStart"] = s.start
         row["winEnd"] = s.end + self.window.gap_ms
         if self.node.having is not None:

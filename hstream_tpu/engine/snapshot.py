@@ -377,6 +377,14 @@ def _restore_table_join(plan, meta, arrays, *, initial_keys: int,
 # ---- join -------------------------------------------------------------------
 
 def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
+    if getattr(ex, "_staged", None):
+        # coalesced matches live outside the inner executor's state;
+        # the owning runtime must flush_staged() (sinking the emitted
+        # rows) before a snapshot, like deferred changelog extracts
+        raise SQLCodegenError(
+            "snapshot with coalesced join matches staged; "
+            "flush_staged() first")
+
     def dump_store(store):
         return [{"k": _enc(key), "t": tss, "r": rows}
                 for key, (tss, rows) in store.by_key.items()]
@@ -397,18 +405,36 @@ def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
 
 def _restore_join(plan, meta, arrays, *, initial_keys: int,
                   batch_capacity: int):
-    from hstream_tpu.engine.join import JoinExecutor, _SideStore
+    from hstream_tpu.engine.join import JoinExecutor
 
     ex = JoinExecutor(plan, initial_keys=initial_keys,
                       batch_capacity=meta.get("batch_capacity",
                                               batch_capacity))
     ex.watermark = meta["watermark"]
     for side, ents in meta["stores"].items():
-        st = _SideStore()
+        codes: list[int] = []
+        tss: list[int] = []
+        rows: list = []
         for ent in ents:
-            st.by_key[tuple(_dec(ent["k"]))] = (
-                [int(t) for t in ent["t"]], ent["r"])
-        ex._stores[side] = st
+            key = tuple(_dec(ent["k"]))
+            c = ex._jcode.get(key)
+            if c is None:
+                c = len(ex._jcode_rev)
+                ex._jcode[key] = c
+                ex._jcode_rev.append(key)
+            for t, r in zip(ent["t"], ent["r"]):
+                codes.append(c)
+                tss.append(int(t))
+                rows.append(r)
+        if not codes:
+            continue
+        code_a = np.asarray(codes, np.int64)
+        ts_a = np.asarray(tss, np.int64)
+        rows_a = np.empty(len(rows), object)
+        rows_a[:] = rows
+        order = np.lexsort((ts_a, code_a))
+        ex._stores[side].insert_sorted(code_a[order], ts_a[order],
+                                       rows_a[order])
     if "i/blob" in arrays:
         inner, _ = restore_executor(ex._inner_plan,
                                     arrays["i/blob"].tobytes(),

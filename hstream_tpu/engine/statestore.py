@@ -18,8 +18,9 @@ import bisect
 class TimestampedKVStore:
     """Per-key timestamped rows: key -> (sorted ts list, rows list).
     The reference's TimestampedKVStore tksPut/tksRange
-    (Processing/Store.hs); the interval join's side stores are exactly
-    this shape."""
+    (Processing/Store.hs). The interval join's side stores use the flat
+    batched restatement of this shape (join._FlatIntervalStore); this
+    per-key form remains the reusable host-operator surface."""
 
     def __init__(self) -> None:
         self.by_key: dict[tuple, tuple[list[int], list[dict]]] = {}

@@ -111,3 +111,23 @@ def test_join_alias_qualifiers():
     assert len(out) == 1
     # select items are named by their SQL text (alias-qualified)
     assert out[0]["a.x"] == 3.0 and out[0]["b.y"] == 4.0
+
+
+def test_flat_store_rejects_timestamp_span_overflow():
+    """A rebase to a much older t0 must fail loudly instead of letting
+    existing rows' composite offsets overflow into a neighboring key
+    code's range (which silently corrupts probes)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from hstream_tpu.common.errors import SQLCodegenError
+    from hstream_tpu.engine.join import _FlatIntervalStore
+
+    st = _FlatIntervalStore([("a",), ("b",)])
+    big = 3_000_000_000_000  # > 2^41
+    st.insert_sorted(np.array([0], np.int64), np.array([big], np.int64),
+                     np.array([{"x": 1}], object))
+    with _pytest.raises(SQLCodegenError):
+        st.insert_sorted(np.array([1], np.int64),
+                         np.array([0], np.int64),   # bogus epoch-0 ts
+                         np.array([{"x": 2}], object))

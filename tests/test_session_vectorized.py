@@ -140,3 +140,22 @@ def test_multi_column_group_key():
     # both (a,1) and (a,2) sessions closed with correct sums
     sums = {(r["k"], r["r"]): r["s"] for r in got}
     assert sums == {("a", 1): 4.0, ("a", 2): 2.0}
+
+
+def test_non_numeric_input_skipped_both_paths():
+    """A malformed value must be NULLed identically on the vectorized
+    and late-segment per-record paths (not crash on one of them)."""
+    aggs = [AggSpec(AggKind.SUM, "s", input=Col("v")),
+            AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, gap=1000, grace=0)
+    ex.process([{"k": "a", "v": 1.0}], [BASE + 50_000])  # wm forward
+    # late batch (seg_t0 + gap <= wm) with a junk value -> per-record
+    # fallback; on-time junk -> vectorized path. Neither may raise.
+    out = ex.process(
+        [{"k": "a", "v": "junk"}, {"k": "a", "v": 2.0},
+         {"k": "b", "v": "junk"}],
+        [BASE + 49_900, BASE + 49_950, BASE + 51_000])
+    rows = ex.process([{"k": "z", "v": 0.0}], [BASE + 200_000])
+    got = {r["k"]: (r["c"], r["s"]) for r in rows if r["k"] in "ab"}
+    assert got["a"] == (3, 3.0), got   # junk counted, not summed
+    assert got["b"] == (1, 0.0), got
