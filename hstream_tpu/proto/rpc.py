@@ -27,11 +27,12 @@ def method_names() -> list[str]:
     return [m.name for m in _SERVICE.methods]
 
 
-def add_hstream_api_to_server(servicer, server) -> None:
-    """Register `servicer` (an object with one method per RPC name) on a
-    grpc.Server."""
+def add_service_to_server(service_desc, servicer, server) -> None:
+    """Register `servicer` (one method per RPC name) for any service
+    descriptor on a grpc.Server."""
+    full_name = service_desc.full_name
     handlers = {}
-    for m in _SERVICE.methods:
+    for m in service_desc.methods:
         in_cls = message_factory.GetMessageClass(m.input_type)
         out_cls = message_factory.GetMessageClass(m.output_type)
         behavior = getattr(servicer, m.name)
@@ -47,17 +48,18 @@ def add_hstream_api_to_server(servicer, server) -> None:
             h = grpc.unary_unary_rpc_method_handler(behavior, deser, ser)
         handlers[m.name] = h
     server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),))
+        (grpc.method_handlers_generic_handler(full_name, handlers),))
 
 
-class HStreamApiStub:
-    """Client stub: one callable per RPC, built from the descriptor."""
+class ServiceStub:
+    """Client stub for any service descriptor (same wire paths a
+    generated stub would use)."""
 
-    def __init__(self, channel: grpc.Channel):
-        for m in _SERVICE.methods:
+    def __init__(self, service_desc, channel: grpc.Channel):
+        for m in service_desc.methods:
             in_cls = message_factory.GetMessageClass(m.input_type)
             out_cls = message_factory.GetMessageClass(m.output_type)
-            path = f"/{SERVICE_NAME}/{m.name}"
+            path = f"/{service_desc.full_name}/{m.name}"
             ser = _serializer(in_cls)
             deser = out_cls.FromString
             if m.client_streaming and m.server_streaming:
@@ -73,3 +75,28 @@ class HStreamApiStub:
                 fn = channel.unary_unary(path, request_serializer=ser,
                                          response_deserializer=deser)
             setattr(self, m.name, fn)
+
+
+REPLICA_SERVICE = api_pb2.DESCRIPTOR.services_by_name["StoreReplica"]
+
+
+def add_store_replica_to_server(servicer, server) -> None:
+    add_service_to_server(REPLICA_SERVICE, servicer, server)
+
+
+class StoreReplicaStub(ServiceStub):
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(REPLICA_SERVICE, channel)
+
+
+def add_hstream_api_to_server(servicer, server) -> None:
+    """Register `servicer` (an object with one method per RPC name) on a
+    grpc.Server."""
+    add_service_to_server(_SERVICE, servicer, server)
+
+
+class HStreamApiStub(ServiceStub):
+    """Client stub: one callable per RPC, built from the descriptor."""
+
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(_SERVICE, channel)

@@ -36,14 +36,25 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           mesh_shape: str | None = None,
           sync_interval_ms: int | None = None,
           segment_bytes: int | None = None,
-          snapshot_interval_ms: int | None = None
+          snapshot_interval_ms: int | None = None,
+          replicate: str | None = None,
+          replication_factor: int = 2
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
     `mesh_shape` ("DxK", e.g. "4x2") shards eligible aggregate queries
-    over a (data, key) device mesh (SURVEY §2.3)."""
+    over a (data, key) device mesh (SURVEY §2.3). `replicate` (comma-
+    separated follower replica addresses) makes this server the store
+    LEADER: every store mutation replicates to those follower nodes
+    (run with ``python -m hstream_tpu.store.replica``) over DCN."""
     store = open_store(store_uri, sync_interval_ms=sync_interval_ms,
                        segment_bytes=segment_bytes)
+    if replicate:
+        from hstream_tpu.store.replica import ReplicatedStore
+
+        store = ReplicatedStore(
+            store, [a.strip() for a in replicate.split(",") if a.strip()],
+            replication_factor=replication_factor)
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
     ctx = ServerContext(store, host=host, port=port, mesh=mesh)
     if snapshot_interval_ms is not None:
@@ -96,12 +107,20 @@ def _parse_args(argv):
                     help="native store segment roll size")
     ap.add_argument("--snapshot-interval-ms", type=int, default=None,
                     help="operator-state snapshot + checkpoint cadence")
+    ap.add_argument("--replicate", default=None, metavar="ADDR,ADDR",
+                    help="follower store-replica addresses; this server "
+                         "becomes the store leader and replicates every "
+                         "mutation to them (reference: server.hs "
+                         "--replicate-factor onto LogDevice)")
+    ap.add_argument("--replication-factor", type=int, default=None,
+                    help="copies (incl. leader) an append waits for")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
                 "workers": 32, "mesh": None, "log_level": None,
                 "sync_interval_ms": None, "segment_bytes": None,
-                "snapshot_interval_ms": None}
+                "snapshot_interval_ms": None, "replicate": None,
+                "replication_factor": 2}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -133,7 +152,9 @@ def main(argv=None) -> None:
         max_workers=cfg["workers"], mesh_shape=cfg["mesh"],
         sync_interval_ms=cfg["sync_interval_ms"],
         segment_bytes=cfg["segment_bytes"],
-        snapshot_interval_ms=cfg["snapshot_interval_ms"])
+        snapshot_interval_ms=cfg["snapshot_interval_ms"],
+        replicate=cfg["replicate"],
+        replication_factor=cfg["replication_factor"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -1,0 +1,480 @@
+"""Multi-host store replication over DCN.
+
+The reference's storage tier is a replicated LogDevice cluster: the
+server takes a ``replicate-factor`` flag and the whole cbits layer binds
+a store that survives node loss (reference hstream/app/server.hs:83-90,
+hstream-store/include/hs_logdevice.h). The embedded store here is
+single-node, so this module adds the replication layer:
+
+  * every mutating store op (append/trim/create/remove/meta) becomes an
+    entry in a durable **op-log** — a reserved log inside the local
+    store itself, so the replication stream is recoverable from disk;
+  * the **leader** applies ops locally, then per-follower sender
+    threads stream op-log entries IN ORDER over gRPC (DCN); a follower
+    response always carries its applied sequence, so a lagging or
+    rejoining follower is caught up from the leader's op-log — the
+    same path as steady-state replication;
+  * **followers** apply entries deterministically to their own local
+    store; starting from the same initial state, replicas are
+    byte-identical (same LSNs, same segments' logical content);
+  * appends ack once ``replication_factor - 1`` followers (or every
+    live follower, whichever is fewer) have applied the entry —
+    availability over strict durability when nodes are down, with the
+    degradation logged (LogDevice instead re-routes to other nodes of
+    a larger cluster);
+  * reads stay local on any replica (gap semantics are the local
+    store's own).
+
+Leadership is static configuration (``--replica-role leader``); leader
+election is the cluster scheduler's concern, not the storage layer's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Sequence
+
+import grpc
+
+from hstream_tpu.common.errors import StoreIOError
+from hstream_tpu.common.logger import get_logger
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import StoreReplicaStub, add_store_replica_to_server
+from hstream_tpu.store.api import Compression, LogAttrs, LogStore
+
+log = get_logger("replica")
+
+# reserved logid holding the replication op-log inside each local store
+OPLOG_ID = (1 << 61) + 7
+
+_ACK_TIMEOUT_S = 5.0
+_RETRY_S = 1.0
+
+
+def _encode_entry(e: pb.LogEntry) -> bytes:
+    return e.SerializeToString()
+
+
+def _decode_entry(b: bytes) -> pb.LogEntry:
+    return pb.LogEntry.FromString(b)
+
+
+def _apply(store: LogStore, e: pb.LogEntry) -> None:
+    """Apply one op to a local store. Deterministic: every replica
+    applies the same entries in the same order."""
+    if e.op == pb.OP_APPEND:
+        store.append_batch(e.logid, list(e.payloads),
+                           Compression(e.compression))
+    elif e.op == pb.OP_TRIM:
+        store.trim(e.logid, e.trim_lsn)
+    elif e.op == pb.OP_CREATE_LOG:
+        if not store.log_exists(e.logid):
+            store.create_log(e.logid, LogAttrs(
+                replication_factor=e.replication_factor or 1,
+                backlog_seconds=e.backlog_seconds))
+    elif e.op == pb.OP_REMOVE_LOG:
+        if store.log_exists(e.logid):
+            store.remove_log(e.logid)
+    elif e.op == pb.OP_META_PUT:
+        store.meta_put(e.meta_key, e.meta_value)
+    elif e.op == pb.OP_META_DELETE:
+        store.meta_delete(e.meta_key)
+    else:  # unknown op from a newer leader: fail loudly, don't diverge
+        raise ValueError(f"unknown replication op {e.op}")
+
+
+class _Follower:
+    """Leader-side sender for one follower: an in-order stream of
+    op-log entries driven by the follower's acked sequence."""
+
+    def __init__(self, addr: str, owner: "ReplicatedStore"):
+        self.addr = addr
+        self.owner = owner
+        self.acked_seq = 0
+        self.alive = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"repl-{addr}", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        owner = self.owner
+        while not owner._stop.is_set():
+            try:
+                with grpc.insecure_channel(self.addr) as ch:
+                    stub = StoreReplicaStub(ch)
+                    info = stub.ReplicaInfo(pb.ReplicaInfoRequest(),
+                                            timeout=_ACK_TIMEOUT_S)
+                    self.acked_seq = info.applied_seq
+                    if not self.alive:
+                        log.info("follower %s up at seq %d", self.addr,
+                                 self.acked_seq)
+                    self.alive = True
+                    with owner._cond:
+                        owner._cond.notify_all()
+                    self._stream(stub)
+            except Exception as e:  # noqa: BLE001 — any failure (RPC,
+                # local read, decode) must keep the retry loop alive and
+                # the follower marked down, never kill the sender thread
+                # with alive stuck True
+                if self.alive:
+                    log.warning("follower %s down: %s", self.addr,
+                                e.code() if isinstance(e, grpc.RpcError)
+                                else e)
+                self.alive = False
+                with owner._cond:
+                    owner._cond.notify_all()
+                if owner._stop.wait(_RETRY_S):
+                    return
+        self.alive = False
+
+    def _stream(self, stub) -> None:
+        owner = self.owner
+        reader = owner.local.new_reader()
+        reader.set_timeout(0)
+        pos = 0  # next seq the persistent reader is positioned at
+        try:
+            while not owner._stop.is_set():
+                with owner._cond:
+                    while (self.acked_seq >= owner._seq
+                           and not owner._stop.is_set()):
+                        owner._cond.wait(0.5)
+                    if owner._stop.is_set():
+                        return
+                want = self.acked_seq + 1
+                if pos != want:
+                    if pos:
+                        reader.stop_reading(OPLOG_ID)
+                    reader.start_reading(OPLOG_ID, want)
+                    pos = want
+                entries = []
+                for item in reader.read(64):
+                    if hasattr(item, "payloads"):
+                        for p in item.payloads:
+                            e = _decode_entry(p)
+                            e.seq = item.lsn  # seq IS the op-log LSN
+                            entries.append(e)
+                if not entries:
+                    continue
+                pos = entries[-1].seq + 1
+                resp = stub.Replicate(
+                    pb.ReplicateRequest(entries=entries,
+                                        leader_id=owner.node_id),
+                    timeout=_ACK_TIMEOUT_S)
+                # the follower's word is authoritative: a lagging
+                # applied seq rewinds the stream (e.g. it restarted
+                # from older disk)
+                self.acked_seq = resp.applied_seq
+                with owner._cond:
+                    owner._cond.notify_all()
+        finally:
+            if pos:
+                reader.stop_reading(OPLOG_ID)
+
+
+class ReplicatedStore(LogStore):
+    """Leader-side LogStore: applies locally + replicates to followers.
+
+    Mutations go through the durable op-log; reads and introspection are
+    the local store's. ``append_batch`` blocks until the entry is
+    applied on min(replication_factor-1, live followers) replicas."""
+
+    def __init__(self, local: LogStore, followers: Sequence[str], *,
+                 replication_factor: int = 2,
+                 node_id: str = "leader"):
+        self.local = local
+        self.node_id = node_id
+        self.replication_factor = max(int(replication_factor), 1)
+        self._stop = threading.Event()
+        self._cond = threading.Condition()
+        self._broken: BaseException | None = None
+        if not local.log_exists(OPLOG_ID):
+            local.create_log(OPLOG_ID)
+        self._seq = local.tail_lsn(OPLOG_ID)  # durable across restarts
+        self._followers = [_Follower(a, self) for a in followers]
+        for f in self._followers:
+            f.start()
+
+    # ---- replication core --------------------------------------------------
+
+    def _check_broken(self) -> None:
+        if self._broken is not None:
+            raise StoreIOError(
+                f"replicated store is in a broken state (an op was "
+                f"logged but failed to apply locally): {self._broken}")
+
+    def _replicate(self, entry: pb.LogEntry, *, wait: bool = True) -> None:
+        """Durably log + locally apply + wait for follower acks."""
+        self._check_broken()
+        with self._cond:
+            seq = self.local.append(OPLOG_ID, _encode_entry(entry))
+            self._seq = seq
+            try:
+                _apply(self.local, entry)
+            except Exception as e:  # noqa: BLE001
+                # the op is durably logged (followers WILL apply it) but
+                # this replica didn't: refusing further mutations beats
+                # silent divergence
+                self._broken = e
+                log.error("leader apply failed at seq %d: %s", seq, e)
+                raise
+            self._cond.notify_all()
+        if wait:
+            self._wait_acks(seq)
+
+    def follower_status(self) -> list[dict]:
+        return [{"addr": f.addr, "alive": f.alive,
+                 "acked_seq": f.acked_seq}
+                for f in self._followers]
+
+    @property
+    def oplog_seq(self) -> int:
+        return self._seq
+
+    # ---- LogStore: mutations (replicated) ----------------------------------
+
+    def create_log(self, logid: int, attrs: LogAttrs | None = None) -> None:
+        a = attrs or LogAttrs()
+        self._replicate(pb.LogEntry(
+            op=pb.OP_CREATE_LOG, logid=logid,
+            replication_factor=a.replication_factor,
+            backlog_seconds=a.backlog_seconds))
+
+    def remove_log(self, logid: int) -> None:
+        self._replicate(pb.LogEntry(op=pb.OP_REMOVE_LOG, logid=logid))
+
+    def append_batch(self, logid: int, payloads: Sequence[bytes],
+                     compression: Compression = Compression.NONE) -> int:
+        self._check_broken()
+        entry = pb.LogEntry(op=pb.OP_APPEND, logid=logid,
+                            payloads=[bytes(p) for p in payloads],
+                            compression=compression.value)
+        with self._cond:
+            seq = self.local.append(OPLOG_ID, _encode_entry(entry))
+            self._seq = seq
+            try:
+                lsn = self.local.append_batch(logid, payloads,
+                                              compression)
+            except Exception as e:  # noqa: BLE001 — see _replicate
+                self._broken = e
+                log.error("leader apply failed at seq %d: %s", seq, e)
+                raise
+            self._cond.notify_all()
+        self._wait_acks(seq)
+        return lsn
+
+    def _wait_acks(self, seq: int) -> None:
+        if not self._followers:
+            return
+        need = min(self.replication_factor - 1, len(self._followers))
+        if need <= 0:
+            return
+        deadline = time.monotonic() + _ACK_TIMEOUT_S
+        with self._cond:
+            while True:
+                acked = sum(1 for f in self._followers
+                            if f.acked_seq >= seq)
+                if acked >= need:
+                    return
+                live = sum(1 for f in self._followers if f.alive)
+                if acked >= live:
+                    if live < need:
+                        log.warning(
+                            "replication degraded: %d/%d followers "
+                            "live; seq %d acked by %d", live,
+                            len(self._followers), seq, acked)
+                        return
+                if time.monotonic() > deadline:
+                    log.warning(
+                        "replication ack timeout at seq %d (%d/%d)",
+                        seq, acked, need)
+                    return
+                self._cond.wait(0.2)
+
+    def trim(self, logid: int, up_to_lsn: int) -> None:
+        self._replicate(pb.LogEntry(op=pb.OP_TRIM, logid=logid,
+                                    trim_lsn=up_to_lsn))
+
+    def meta_put(self, key: str, value: bytes) -> None:
+        self._replicate(pb.LogEntry(op=pb.OP_META_PUT, meta_key=key,
+                                    meta_value=value), wait=False)
+
+    def meta_delete(self, key: str) -> None:
+        self._replicate(pb.LogEntry(op=pb.OP_META_DELETE, meta_key=key),
+                        wait=False)
+
+    def meta_cas(self, key: str, expected: bytes | None,
+                 value: bytes) -> bool:
+        # CAS decided on the leader (the single sequencer), replicated
+        # as its winning put. CAS + op-log append stay in ONE critical
+        # section: two racing winners must log their puts in decision
+        # order, or the earlier value would overwrite the later one on
+        # every replica.
+        self._check_broken()
+        with self._cond:
+            ok = self.local.meta_cas(key, expected, value)
+            if ok:
+                seq = self.local.append(OPLOG_ID, _encode_entry(
+                    pb.LogEntry(op=pb.OP_META_PUT, meta_key=key,
+                                meta_value=value)))
+                self._seq = seq
+                self._cond.notify_all()
+        return ok
+
+    # ---- LogStore: reads/introspection (local) -----------------------------
+
+    def log_exists(self, logid: int) -> bool:
+        return self.local.log_exists(logid)
+
+    def list_logs(self) -> list[int]:
+        return [l for l in self.local.list_logs() if l != OPLOG_ID]
+
+    def log_attrs(self, logid: int) -> LogAttrs:
+        return self.local.log_attrs(logid)
+
+    def tail_lsn(self, logid: int) -> int:
+        return self.local.tail_lsn(logid)
+
+    def trim_point(self, logid: int) -> int:
+        return self.local.trim_point(logid)
+
+    def find_time(self, logid: int, ts_ms: int) -> int:
+        return self.local.find_time(logid, ts_ms)
+
+    def is_log_empty(self, logid: int) -> bool:
+        return self.local.is_log_empty(logid)
+
+    def new_reader(self, max_logs: int = 1):
+        return self.local.new_reader(max_logs)
+
+    def meta_get(self, key: str) -> bytes | None:
+        return self.local.meta_get(key)
+
+    def meta_list(self, prefix: str) -> list[str]:
+        return self.local.meta_list(prefix)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for f in self._followers:
+            f._thread.join(timeout=2)
+        self.local.close()
+
+    # async append parity with the native store (sink fast path)
+    def append_async(self, logid: int, payloads: Sequence[bytes]):
+        fut: "futures.Future[int]" = futures.Future()
+        try:
+            fut.set_result(self.append_batch(logid, payloads))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+        return fut
+
+
+class FollowerService:
+    """Follower-side gRPC service: applies in-order entries to the
+    local store; always answers with its applied sequence."""
+
+    def __init__(self, local: LogStore, *, node_id: str = "follower"):
+        self.local = local
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._broken: BaseException | None = None
+        if not local.log_exists(OPLOG_ID):
+            local.create_log(OPLOG_ID)
+
+    @property
+    def applied_seq(self) -> int:
+        return self.local.tail_lsn(OPLOG_ID)
+
+    def Replicate(self, request, context):
+        with self._lock:
+            if self._broken is not None:
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"replica diverged and refuses entries: "
+                    f"{self._broken}")
+            applied = self.applied_seq
+            for e in request.entries:
+                if e.seq and e.seq != applied + 1:
+                    break  # out of order: answer with where we are
+                # apply FIRST, log second: a failed apply must not
+                # advance applied_seq (= op-log tail), or the leader
+                # would skip the op forever and the replica silently
+                # diverges. If apply succeeds but the op-log append
+                # fails, re-applying on retry WOULD duplicate the op —
+                # mark the replica broken (operator re-bootstraps it)
+                # rather than diverge quietly either way.
+                try:
+                    _apply(self.local, e)
+                except Exception as exc:  # noqa: BLE001
+                    log.error("replica %s: apply failed at seq %d: %s",
+                              self.node_id, e.seq, exc)
+                    context.abort(grpc.StatusCode.INTERNAL,
+                                  f"apply failed at seq {e.seq}: {exc}")
+                try:
+                    applied = self.local.append(OPLOG_ID,
+                                                _encode_entry(e))
+                except Exception as exc:  # noqa: BLE001
+                    self._broken = exc
+                    log.error(
+                        "replica %s BROKEN: op %d applied but not "
+                        "logged: %s", self.node_id, e.seq, exc)
+                    context.abort(grpc.StatusCode.INTERNAL,
+                                  f"op-log append failed: {exc}")
+            return pb.ReplicateResponse(applied_seq=applied)
+
+    def ReplicaInfo(self, request, context):
+        return pb.ReplicaInfoResponse(applied_seq=self.applied_seq,
+                                      is_leader=False,
+                                      node_id=self.node_id)
+
+
+def serve_follower(local: LogStore, listen: str, *,
+                   node_id: str = "follower"):
+    """Start a follower replica service; returns (grpc server, svc)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    svc = FollowerService(local, node_id=node_id)
+    add_store_replica_to_server(svc, server)
+    server.add_insecure_port(listen)
+    server.start()
+    log.info("store replica follower %s listening on %s", node_id, listen)
+    return server, svc
+
+
+def follower_main(argv=None) -> None:
+    """Run a follower store replica node:
+    ``python -m hstream_tpu.store.replica --store DIR --listen ADDR``"""
+    import argparse
+    import signal
+    import threading as _threading
+
+    from hstream_tpu.store import open_store
+
+    ap = argparse.ArgumentParser("hstream-tpu-store-replica")
+    ap.add_argument("--store", required=True,
+                    help="mem:// or a directory for the local store")
+    ap.add_argument("--listen", required=True, metavar="HOST:PORT")
+    ap.add_argument("--node-id", default="follower")
+    args = ap.parse_args(argv)
+
+    local = open_store(args.store)
+    server, _svc = serve_follower(local, args.listen,
+                                  node_id=args.node_id)
+    done = _threading.Event()
+
+    def on_signal(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    done.wait()
+    server.stop(grace=1)
+    local.close()
+
+
+if __name__ == "__main__":
+    follower_main()
