@@ -30,6 +30,7 @@ from hstream_tpu.common.idgen import gen_unique
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server import scheduler
 from hstream_tpu.server.persistence import (
     QUERY_PUSH,
     QUERY_STREAM,
@@ -331,6 +332,12 @@ class HStreamApiServicer:
             if info.status not in (TaskStatus.RUNNING, TaskStatus.CREATED):
                 continue
             if info.query_id in ctx.running_queries:
+                continue
+            # scheduler seed (SURVEY §2.3 task distribution): only
+            # adopt queries whose recorded owner is gone — its boot
+            # epoch predates ours; the claim is a CAS, so two racing
+            # successors cannot both take one query
+            if not scheduler.try_adopt(ctx, info.query_id):
                 continue
             try:
                 self._resume_query(info)
@@ -772,6 +779,7 @@ class HStreamApiServicer:
                          created_time_ms=now_ms(), query_type=qtype,
                          status=TaskStatus.CREATED, sink=sink_stream)
         ctx.persistence.insert_query(info)
+        scheduler.record_assignment(ctx, query_id)
         task = QueryTask(ctx, info, plan,
                          stream_sink(ctx, sink_stream, sink_type))
         ctx.running_queries[query_id] = task
@@ -791,6 +799,7 @@ class HStreamApiServicer:
         if task is not None:
             task.stop()
         ctx.persistence.set_query_status(query_id, TaskStatus.TERMINATED)
+        scheduler.drop_assignment(ctx, query_id)
 
     def _create_view(self, plan: plans.CreateViewPlan,
                      sql: str) -> QueryInfo:
@@ -801,6 +810,7 @@ class HStreamApiServicer:
                          created_time_ms=now_ms(), query_type=QUERY_VIEW,
                          status=TaskStatus.CREATED, sink=plan.view)
         ctx.persistence.insert_query(info)
+        scheduler.record_assignment(ctx, query_id)
         self._start_view_task(info, plan)
         return info
 
@@ -836,6 +846,7 @@ class HStreamApiServicer:
         except QueryNotFound:
             pass
         self._remove_query_state(query_id)
+        scheduler.drop_assignment(ctx, query_id)
 
     def _create_connector(self, cid: str, sql: str,
                           plan: plans.CreateSinkConnectorPlan

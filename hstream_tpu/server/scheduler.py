@@ -1,0 +1,117 @@
+"""Query->server assignment in the versioned config store (the task-
+distribution seed, SURVEY §2.3).
+
+The reference is single-process here too (every query runs in the one
+server, Handler.hs:373-375); SURVEY's TPU-native column asks for a
+scheduler persisting query placement in cluster metadata. This module
+records, for every launched query, which server owns it — keyed
+``scheduler/query/<qid>`` in the CAS-versioned config store — and lets
+a booting server ADOPT queries whose owner is gone (its recorded boot
+epoch predates ours; the boot-epoch CAS in ServerContext makes epochs
+total-ordered per store). Adoption is itself a CAS, so two racing
+successors cannot both take a query.
+
+Liveness here is epoch-based (single store, one active server at a
+time — a successor always boots with a higher epoch). A multi-server
+deployment over the replicated store adds heartbeats on the same
+records; the CAS adoption path is unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+
+from hstream_tpu.common.logger import get_logger
+from hstream_tpu.store.versioned import VersionMismatch
+
+log = get_logger("scheduler")
+
+_PREFIX = "scheduler/query/"
+
+
+def _key(query_id: str) -> str:
+    return _PREFIX + query_id
+
+
+def node_name(ctx) -> str:
+    return f"server-{ctx.server_id}@{ctx.host}:{ctx.port}"
+
+
+def record_assignment(ctx, query_id: str) -> None:
+    """Unconditionally claim a query for this server (fresh launches:
+    the creating server owns the query)."""
+    value = json.dumps({"node": node_name(ctx),
+                        "epoch": ctx.boot_epoch}).encode()
+    for _ in range(16):
+        cur = ctx.config.get(_key(query_id))
+        try:
+            ctx.config.put(_key(query_id), value,
+                           base_version=None if cur is None else cur[0])
+            return
+        except VersionMismatch:
+            continue
+    log.warning("assignment write for %s kept losing CAS", query_id)
+
+
+def drop_assignment(ctx, query_id: str) -> None:
+    cur = ctx.config.get(_key(query_id))
+    if cur is None:
+        return
+    try:
+        ctx.config.delete(_key(query_id), base_version=cur[0])
+    except VersionMismatch:
+        pass  # someone re-claimed it; their record stands
+
+
+def assignment(ctx, query_id: str) -> dict | None:
+    cur = ctx.config.get(_key(query_id))
+    if cur is None:
+        return None
+    try:
+        return json.loads(cur[1])
+    except ValueError:
+        return None
+
+
+def try_adopt(ctx, query_id: str) -> bool:
+    """CAS-claim an unowned or dead-owner query at boot. True = this
+    server now owns it and should resume it."""
+    cur = ctx.config.get(_key(query_id))
+    mine = json.dumps({"node": node_name(ctx),
+                       "epoch": ctx.boot_epoch}).encode()
+    if cur is None:
+        try:
+            ctx.config.put(_key(query_id), mine)
+            return True
+        except VersionMismatch:
+            return False
+    version, raw = cur
+    try:
+        owner = json.loads(raw)
+    except ValueError:
+        owner = {"node": "?", "epoch": 0}
+    if int(owner.get("epoch", 0)) >= ctx.boot_epoch:
+        # owned under an epoch at least as new as ours: a live peer
+        log.info("query %s owned by %s (epoch %s); not adopting",
+                 query_id, owner.get("node"), owner.get("epoch"))
+        return False
+    try:
+        ctx.config.put(_key(query_id), mine, base_version=version)
+        log.info("adopted query %s from %s (epoch %s -> %s)", query_id,
+                 owner.get("node"), owner.get("epoch"), ctx.boot_epoch)
+        return True
+    except VersionMismatch:
+        return False  # a racing successor won the claim
+
+
+def assignments(ctx) -> dict[str, dict]:
+    """query_id -> owner record (admin/introspection)."""
+    out = {}
+    for key in ctx.config.keys():
+        if not key.startswith(_PREFIX):
+            continue
+        qid = key[len(_PREFIX):]
+        a = assignment(ctx, qid)
+        if a is not None:
+            out[qid] = a
+    return out

@@ -125,8 +125,12 @@ class LogStore:
         return self.append_batch(logid, [payload], compression)
 
     def append_batch(self, logid: int, payloads: Sequence[bytes],
-                     compression: Compression = Compression.NONE) -> int:
-        """Append a batch under a single LSN; returns that LSN."""
+                     compression: Compression = Compression.NONE, *,
+                     append_time_ms: int | None = None) -> int:
+        """Append a batch under a single LSN; returns that LSN.
+        `append_time_ms` overrides the local wall-clock stamp —
+        replication passes the leader's stamp so every replica agrees
+        on find_time/backlog answers."""
         raise NotImplementedError
 
     # ---- introspection ----

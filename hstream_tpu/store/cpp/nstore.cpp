@@ -231,7 +231,8 @@ struct Store {
   int64_t append_locked(uint64_t logid,
                         const std::vector<const uint8_t*>& ptrs,
                         const std::vector<uint32_t>& lens,
-                        uint32_t compression, char* err) {
+                        uint32_t compression, char* err,
+                        int64_t force_time_ms = 0) {
     Log* log = get(logid);
     if (!log) {
       set_err(err, "log not found");
@@ -266,9 +267,12 @@ struct Store {
       stored = raw;
     }
 
-    int64_t now_ms = (int64_t)std::chrono::duration_cast<
-        std::chrono::milliseconds>(std::chrono::system_clock::now()
-                                       .time_since_epoch()).count();
+    int64_t now_ms = force_time_ms;
+    if (now_ms == 0)  // 0 = stamp locally; replication passes the
+                      // leader's stamp so replicas agree on find_time
+      now_ms = (int64_t)std::chrono::duration_cast<
+          std::chrono::milliseconds>(std::chrono::system_clock::now()
+                                         .time_since_epoch()).count();
     int64_t lsn = log->next_lsn++;
     uint32_t crc = crc32(0, reinterpret_cast<const Bytef*>(stored.data()),
                          stored.size());
@@ -687,7 +691,8 @@ int64_t ns_log_attrs(void* h, uint64_t logid, char* out, int64_t cap) {
 
 int64_t ns_append_batch(void* h, uint64_t logid, const uint8_t* buf,
                         const uint32_t* lens, uint32_t nrecs,
-                        int compression, int durable, char* err) {
+                        int compression, int durable, char* err,
+                        int64_t time_ms) {
   auto* st = static_cast<Store*>(h);
   std::unique_lock<std::mutex> lk(st->mu);
   std::vector<const uint8_t*> ptrs(nrecs);
@@ -698,7 +703,7 @@ int64_t ns_append_batch(void* h, uint64_t logid, const uint8_t* buf,
     off += lens[i];
   }
   int64_t lsn = st->append_locked(logid, ptrs, lvec,
-                                  (uint32_t)compression, err);
+                                  (uint32_t)compression, err, time_ms);
   if (lsn > 0 && durable) st->wait_durable(lk);
   return lsn;
 }

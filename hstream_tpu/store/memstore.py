@@ -82,14 +82,15 @@ class MemLogStore(LogStore):
 
     # ---- append ----
     def append_batch(self, logid: int, payloads: Sequence[bytes],
-                     compression: Compression = Compression.NONE) -> int:
+                     compression: Compression = Compression.NONE, *,
+                     append_time_ms: int | None = None) -> int:
         if not payloads:
             raise StoreError("empty batch")
         with self._data_cond:
             log = self._get(logid)
             lsn = log.next_lsn
             log.next_lsn += 1
-            now = int(time.time() * 1000)
+            now = append_time_ms or int(time.time() * 1000)
             log.lsns.append(lsn)
             log.times.append(now)
             log.batches[lsn] = DataBatch(

@@ -66,7 +66,7 @@ def _load():
         lib.ns_append_batch.restype = C.c_int64
         lib.ns_append_batch.argtypes = [
             C.c_void_p, C.c_uint64, C.c_char_p, C.POINTER(C.c_uint32),
-            C.c_uint32, C.c_int, C.c_int, C.c_char_p]
+            C.c_uint32, C.c_int, C.c_int, C.c_char_p, C.c_int64]
         lib.ns_append_async.argtypes = [
             C.c_void_p, C.c_uint64, C.c_char_p, C.POINTER(C.c_uint32),
             C.c_uint32, C.c_int, C.c_uint64]
@@ -175,14 +175,16 @@ class NativeLogStore(LogStore):
 
     # ---- append ----
     def append_batch(self, logid: int, payloads: Sequence[bytes],
-                     compression: Compression = Compression.NONE) -> int:
+                     compression: Compression = Compression.NONE, *,
+                     append_time_ms: int | None = None) -> int:
         if not payloads:
             raise StoreError("empty batch")
         buf, lens = _pack_payloads(payloads)
         err = C.create_string_buffer(256)
         lsn = self._lib.ns_append_batch(
             self._h, logid, buf, lens, len(payloads),
-            1 if compression == Compression.ZLIB else 0, 1, err)
+            1 if compression == Compression.ZLIB else 0, 1, err,
+            append_time_ms or 0)
         if lsn < 0:
             msg = err.value.decode()
             if "not found" in msg:

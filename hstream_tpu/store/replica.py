@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from concurrent import futures
 from typing import Sequence
 
@@ -62,11 +63,21 @@ def _decode_entry(b: bytes) -> pb.LogEntry:
 
 
 def _apply(store: LogStore, e: pb.LogEntry) -> None:
-    """Apply one op to a local store. Deterministic: every replica
-    applies the same entries in the same order."""
+    """Apply one op to a local store. Deterministic AND idempotent:
+    every replica applies the same entries in the same order, and
+    re-applying an entry after a crash in the apply/log window is a
+    no-op (appends are guarded by expect_lsn; the other ops are
+    naturally idempotent)."""
     if e.op == pb.OP_APPEND:
-        store.append_batch(e.logid, list(e.payloads),
-                           Compression(e.compression))
+        if e.expect_lsn and store.tail_lsn(e.logid) >= e.expect_lsn:
+            return  # already applied (crash between apply and log)
+        lsn = store.append_batch(e.logid, list(e.payloads),
+                                 Compression(e.compression),
+                                 append_time_ms=e.append_time_ms or None)
+        if e.expect_lsn and lsn != e.expect_lsn:
+            raise StoreIOError(
+                f"replica diverged: append to log {e.logid} landed at "
+                f"lsn {lsn}, expected {e.expect_lsn}")
     elif e.op == pb.OP_TRIM:
         store.trim(e.logid, e.trim_lsn)
     elif e.op == pb.OP_CREATE_LOG:
@@ -83,6 +94,26 @@ def _apply(store: LogStore, e: pb.LogEntry) -> None:
         store.meta_delete(e.meta_key)
     else:  # unknown op from a newer leader: fail loudly, don't diverge
         raise ValueError(f"unknown replication op {e.op}")
+
+
+def _reconcile(store: LogStore) -> None:
+    """Crash recovery for the apply/log window: ops are serialized, so
+    at most the LAST op-log entry can be logged-but-unapplied (leader
+    logs first) — re-apply it; idempotence makes this safe when it DID
+    apply."""
+    tail = store.tail_lsn(OPLOG_ID)
+    if not tail:
+        return
+    reader = store.new_reader()
+    reader.set_timeout(0)
+    reader.start_reading(OPLOG_ID, tail, tail)
+    for item in reader.read(4):
+        if hasattr(item, "payloads"):
+            for p in item.payloads:
+                e = _decode_entry(p)
+                e.seq = item.lsn
+                _apply(store, e)
+    reader.stop_reading(OPLOG_ID)
 
 
 class _Follower:
@@ -151,12 +182,26 @@ class _Follower:
                     reader.start_reading(OPLOG_ID, want)
                     pos = want
                 entries = []
+                gap_hi = 0
                 for item in reader.read(64):
                     if hasattr(item, "payloads"):
                         for p in item.payloads:
                             e = _decode_entry(p)
                             e.seq = item.lsn  # seq IS the op-log LSN
                             entries.append(e)
+                    elif hasattr(item, "hi_lsn"):
+                        gap_hi = max(gap_hi, item.hi_lsn)
+                if gap_hi and not entries:
+                    # the follower is below the op-log trim point:
+                    # catch-up cannot reconstruct those ops. Stop
+                    # replicating to it — operator re-bootstraps the
+                    # replica from a copy of a live store.
+                    log.error(
+                        "follower %s needs entries up to seq %d but "
+                        "the op-log is trimmed to %d; re-bootstrap "
+                        "this replica", self.addr, gap_hi,
+                        self.owner.local.trim_point(OPLOG_ID))
+                    raise StoreIOError("follower below op-log trim")
                 if not entries:
                     continue
                 pos = entries[-1].seq + 1
@@ -184,15 +229,21 @@ class ReplicatedStore(LogStore):
 
     def __init__(self, local: LogStore, followers: Sequence[str], *,
                  replication_factor: int = 2,
-                 node_id: str = "leader"):
+                 node_id: str | None = None):
         self.local = local
-        self.node_id = node_id
+        # unique by default: a follower rejects entries from a second
+        # leader by id, which only works if ids actually differ
+        self.node_id = node_id or f"leader-{uuid.uuid4().hex[:10]}"
         self.replication_factor = max(int(replication_factor), 1)
         self._stop = threading.Event()
         self._cond = threading.Condition()
         self._broken: BaseException | None = None
+        self._async_pool = futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="repl-ack")
+        self._ops_since_trim = 0
         if not local.log_exists(OPLOG_ID):
             local.create_log(OPLOG_ID)
+        _reconcile(local)  # crash in the log/apply window: replay last
         self._seq = local.tail_lsn(OPLOG_ID)  # durable across restarts
         self._followers = [_Follower(a, self) for a in followers]
         for f in self._followers:
@@ -206,10 +257,19 @@ class ReplicatedStore(LogStore):
                 f"replicated store is in a broken state (an op was "
                 f"logged but failed to apply locally): {self._broken}")
 
-    def _replicate(self, entry: pb.LogEntry, *, wait: bool = True) -> None:
-        """Durably log + locally apply + wait for follower acks."""
+    def _log_and_apply(self, entry: pb.LogEntry) -> int:
+        """The one critical section: durably log the op, apply it
+        locally, wake the sender threads. Returns the op's seq.
+        Caller holds nothing; broken-state on apply failure."""
         self._check_broken()
         with self._cond:
+            if entry.op == pb.OP_APPEND:
+                # stamp idempotence + time BEFORE logging, under the
+                # lock: replicas must land the append at this LSN with
+                # this timestamp
+                entry.expect_lsn = self.local.tail_lsn(entry.logid) + 1
+                if not entry.append_time_ms:
+                    entry.append_time_ms = int(time.time() * 1000)
             seq = self.local.append(OPLOG_ID, _encode_entry(entry))
             self._seq = seq
             try:
@@ -222,6 +282,10 @@ class ReplicatedStore(LogStore):
                 log.error("leader apply failed at seq %d: %s", seq, e)
                 raise
             self._cond.notify_all()
+        return seq
+
+    def _replicate(self, entry: pb.LogEntry, *, wait: bool = True) -> None:
+        seq = self._log_and_apply(entry)
         if wait:
             self._wait_acks(seq)
 
@@ -247,24 +311,33 @@ class ReplicatedStore(LogStore):
         self._replicate(pb.LogEntry(op=pb.OP_REMOVE_LOG, logid=logid))
 
     def append_batch(self, logid: int, payloads: Sequence[bytes],
-                     compression: Compression = Compression.NONE) -> int:
-        self._check_broken()
+                     compression: Compression = Compression.NONE, *,
+                     append_time_ms: int | None = None) -> int:
         entry = pb.LogEntry(op=pb.OP_APPEND, logid=logid,
                             payloads=[bytes(p) for p in payloads],
-                            compression=compression.value)
-        with self._cond:
-            seq = self.local.append(OPLOG_ID, _encode_entry(entry))
-            self._seq = seq
-            try:
-                lsn = self.local.append_batch(logid, payloads,
-                                              compression)
-            except Exception as e:  # noqa: BLE001 — see _replicate
-                self._broken = e
-                log.error("leader apply failed at seq %d: %s", seq, e)
-                raise
-            self._cond.notify_all()
+                            compression=compression.value,
+                            append_time_ms=append_time_ms or 0)
+        seq = self._log_and_apply(entry)
         self._wait_acks(seq)
-        return lsn
+        self._maybe_trim_oplog()
+        return entry.expect_lsn
+
+    def _maybe_trim_oplog(self) -> None:
+        """Reclaim op-log space every so often: entries every follower
+        has applied are never needed again (a rejoining follower below
+        the trim point is unrecoverable by catch-up and must be
+        re-bootstrapped from a copy — the trade LogDevice also makes
+        with trimmed logs). A permanently-dead follower pins the op-log
+        until the operator removes it from --replicate."""
+        self._ops_since_trim += 1
+        if self._ops_since_trim < 512 or not self._followers:
+            return
+        self._ops_since_trim = 0
+        if not all(f.alive for f in self._followers):
+            return
+        low = min(f.acked_seq for f in self._followers)
+        if low > self.local.trim_point(OPLOG_ID):
+            self.local.trim(OPLOG_ID, low)
 
     def _wait_acks(self, seq: int) -> None:
         if not self._followers:
@@ -362,16 +435,25 @@ class ReplicatedStore(LogStore):
             self._cond.notify_all()
         for f in self._followers:
             f._thread.join(timeout=2)
+        self._async_pool.shutdown(wait=True)
         self.local.close()
 
-    # async append parity with the native store (sink fast path)
+    # async append parity with the native store (sink fast path): the
+    # local log+apply happens inline (cheap), but the follower-ack wait
+    # moves to a pool thread so the caller keeps its bounded-in-flight
+    # pipelining instead of serializing on a DCN round trip per batch
     def append_async(self, logid: int, payloads: Sequence[bytes]):
-        fut: "futures.Future[int]" = futures.Future()
-        try:
-            fut.set_result(self.append_batch(logid, payloads))
-        except BaseException as e:  # noqa: BLE001
-            fut.set_exception(e)
-        return fut
+        entry = pb.LogEntry(op=pb.OP_APPEND, logid=logid,
+                            payloads=[bytes(p) for p in payloads])
+        seq = self._log_and_apply(entry)
+        lsn = entry.expect_lsn
+
+        def waiter() -> int:
+            self._wait_acks(seq)
+            self._maybe_trim_oplog()
+            return lsn
+
+        return self._async_pool.submit(waiter)
 
 
 class FollowerService:
@@ -383,8 +465,10 @@ class FollowerService:
         self.node_id = node_id
         self._lock = threading.Lock()
         self._broken: BaseException | None = None
+        self._leader_id: str | None = None
         if not local.log_exists(OPLOG_ID):
             local.create_log(OPLOG_ID)
+        _reconcile(local)
 
     @property
     def applied_seq(self) -> int:
@@ -397,6 +481,17 @@ class FollowerService:
                     grpc.StatusCode.INTERNAL,
                     f"replica diverged and refuses entries: "
                     f"{self._broken}")
+            if request.leader_id:
+                if self._leader_id is None:
+                    self._leader_id = request.leader_id
+                elif self._leader_id != request.leader_id:
+                    # two leaders feeding one follower is operator
+                    # error; acking both would silently diverge them
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        f"replica already follows "
+                        f"{self._leader_id!r}, refusing entries from "
+                        f"{request.leader_id!r}")
             applied = self.applied_seq
             for e in request.entries:
                 if e.seq and e.seq != applied + 1:

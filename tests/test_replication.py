@@ -51,7 +51,7 @@ def spawn_follower(store_dir: str, port: int,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
-def wait_follower_up(port: int, timeout: float = 30.0) -> None:
+def wait_follower_up(port: int, timeout: float = 90.0) -> None:
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
@@ -89,7 +89,7 @@ def log_contents(store, logid: int) -> list[tuple[int, tuple[bytes, ...]]]:
 
 
 def wait_caught_up(leader: ReplicatedStore, port: int,
-                   timeout: float = 30.0) -> None:
+                   timeout: float = 90.0) -> None:
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
@@ -257,3 +257,68 @@ def test_server_leader_mode_replicates_streams():
         server.stop(grace=1)
         ctx.shutdown()
         fsrv.stop(grace=1)
+
+
+def test_apply_idempotent_and_reconcile():
+    """Crash in the log/apply window: re-applying the last op-log entry
+    is a no-op (appends guarded by expect_lsn), and _reconcile applies
+    a logged-but-unapplied tail entry."""
+    from hstream_tpu.store.replica import _apply, _encode_entry, _reconcile
+
+    st = open_store("mem://")
+    st.create_log(OPLOG_ID)
+    st.create_log(5)
+    e = pb.LogEntry(op=pb.OP_APPEND, logid=5, payloads=[b"a"],
+                    expect_lsn=1, append_time_ms=123)
+    # leader order: log first, crash before apply -> reconcile applies
+    st.append(OPLOG_ID, _encode_entry(e))
+    _reconcile(st)
+    assert st.tail_lsn(5) == 1
+    # re-applying the same entry must be a no-op
+    _apply(st, e)
+    assert st.tail_lsn(5) == 1
+    assert st.find_time(5, 123) == 1
+
+
+def test_append_time_replicates():
+    """Replicas answer find_time identically: the leader's stamp rides
+    the entry."""
+    follower_store = open_store("mem://")
+    port = free_port()
+    server, svc = serve_follower(follower_store, f"127.0.0.1:{port}")
+    leader = ReplicatedStore(open_store("mem://"),
+                             [f"127.0.0.1:{port}"],
+                             replication_factor=2)
+    try:
+        leader.create_log(9)
+        leader.append_batch(9, [b"x"], append_time_ms=1000)
+        leader.append_batch(9, [b"y"], append_time_ms=2000)
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and svc.applied_seq < leader.oplog_seq):
+            time.sleep(0.05)
+        assert follower_store.find_time(9, 1500) == \
+            leader.local.find_time(9, 1500) == 2
+    finally:
+        leader.close()
+        server.stop(grace=1)
+
+
+def test_follower_rejects_second_leader():
+    follower_store = open_store("mem://")
+    port = free_port()
+    server, svc = serve_follower(follower_store, f"127.0.0.1:{port}")
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stub = StoreReplicaStub(ch)
+            e = pb.LogEntry(seq=1, op=pb.OP_CREATE_LOG, logid=3)
+            stub.Replicate(pb.ReplicateRequest(entries=[e],
+                                               leader_id="L1"), timeout=5)
+            try:
+                stub.Replicate(pb.ReplicateRequest(
+                    entries=[], leader_id="L2"), timeout=5)
+                raise AssertionError("second leader accepted")
+            except grpc.RpcError as err:
+                assert err.code() == grpc.StatusCode.FAILED_PRECONDITION
+    finally:
+        server.stop(grace=1)

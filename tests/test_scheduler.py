@@ -1,0 +1,169 @@
+"""Scheduler seed (SURVEY §2.3 task distribution): query->server
+assignment lives in the CAS-versioned config store; a successor server
+adopts queries whose owner's boot epoch predates its own and resumes
+them from their snapshots. Two-process test: SIGKILL server A, boot
+server B on the same store."""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import grpc
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+
+from helpers import wait_attached
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE = 1_700_000_000_000
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def wait_up(stub, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            stub.Echo(pb.EchoRequest(msg="up"), timeout=1)
+            return
+        except grpc.RpcError:
+            time.sleep(0.3)
+    raise TimeoutError("server never came up")
+
+
+def append_rows(stub, stream, rows, ts):
+    req = pb.AppendRequest(stream_name=stream)
+    for row, t in zip(rows, ts):
+        req.records.append(rec.build_record(row, publish_time_ms=t))
+    return stub.Append(req)
+
+
+def test_successor_adopts_and_resumes_from_snapshot(tmp_path):
+    store_dir = str(tmp_path / "store")
+    port = free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hstream_tpu.server.main",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--store", store_dir, "--snapshot-interval-ms", "100"],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    qid = None
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = HStreamApiStub(ch)
+        wait_up(stub)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text="CREATE STREAM snk AS SELECT k, COUNT(*) AS c "
+                      "FROM src GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
+                      "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;"))
+        qs = stub.ListQueries(pb.ListQueriesRequest()).queries
+        assert len(qs) == 1
+        qid = qs[0].id
+        append_rows(stub, "src", [{"k": "a"} for _ in range(10)],
+                    [BASE + i for i in range(10)])
+        time.sleep(1.5)  # snapshot cadence is 100ms; let state commit
+        ch.close()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(10)
+
+    # successor boots on the same store: it must adopt + resume
+    from hstream_tpu.server import scheduler
+    from hstream_tpu.server.main import serve
+
+    server, ctx = serve("127.0.0.1", 0, store_dir,
+                        snapshot_interval_ms=100)
+    try:
+        assert qid in ctx.running_queries, "query not adopted"
+        a = scheduler.assignment(ctx, qid)
+        assert a is not None and a["epoch"] == ctx.boot_epoch
+        assert a["node"] == scheduler.node_name(ctx)
+        wait_attached(ctx, qid)
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+        stub = HStreamApiStub(ch)
+        # one more record in the SAME window, then close it: the count
+        # must continue from the snapshot (11), not restart at 1
+        append_rows(stub, "src", [{"k": "a"}], [BASE + 100])
+        append_rows(stub, "src", [{"k": "zz"}], [BASE + 60_000])
+        deadline = time.time() + 30
+        best = 0
+        while time.time() < deadline:
+            rows = [rec.record_to_dict(rec.parse_record(r))
+                    for r in _read_all(ctx, "snk")]
+            counts = [r.get("c", 0) for r in rows
+                      if r and r.get("k") == "a"]
+            best = max([best] + counts)
+            if best >= 11:
+                break
+            time.sleep(0.3)
+        assert best == 11, f"resumed count {best} != 11"
+        ch.close()
+    finally:
+        server.stop(grace=1)
+        ctx.shutdown()
+
+
+def _read_all(ctx, stream):
+    from hstream_tpu.common import columnar
+    from hstream_tpu.store.api import DataBatch
+
+    logid = ctx.streams.get_logid(stream)
+    tail = ctx.store.tail_lsn(logid)
+    out = []
+    if not tail:
+        return out
+    r = ctx.store.new_reader()
+    r.set_timeout(0)
+    r.start_reading(logid, 1, tail)
+    while True:
+        items = r.read(256)
+        if not items:
+            break
+        for it in items:
+            if isinstance(it, DataBatch):
+                for p in it.payloads:
+                    pr = rec.parse_record(p)
+                    rows = columnar.payload_rows(pr.payload)
+                    if rows is not None:
+                        out.extend(
+                            rec.build_record(row).SerializeToString()
+                            for row in rows)
+                    else:
+                        out.append(p)
+    return out
+
+
+def test_adoption_skips_live_owner_epoch(tmp_path):
+    """A query whose owner epoch >= ours must NOT be adopted."""
+    from hstream_tpu.server import scheduler
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+
+    store = open_store("mem://")
+    ctx = ServerContext(store)
+    scheduler.record_assignment(ctx, "q1")
+    # same context tries again: owner epoch == ours -> not adoptable
+    assert not scheduler.try_adopt(ctx, "q1")
+    # a later-epoch context adopts it
+    ctx2 = ServerContext(store, persistence=ctx.persistence)
+    assert ctx2.boot_epoch > ctx.boot_epoch
+    assert scheduler.try_adopt(ctx2, "q1")
+    a = scheduler.assignment(ctx2, "q1")
+    assert a["epoch"] == ctx2.boot_epoch
+    assert "q1" in scheduler.assignments(ctx2)
