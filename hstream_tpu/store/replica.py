@@ -96,6 +96,14 @@ def _apply(store: LogStore, e: pb.LogEntry) -> None:
         raise ValueError(f"unknown replication op {e.op}")
 
 
+def _stable_node_id(store: LogStore) -> str:
+    nid = store.meta_get("replica/node_id")
+    if nid is None:
+        nid = f"leader-{uuid.uuid4().hex[:10]}".encode()
+        store.meta_put("replica/node_id", nid)
+    return nid.decode()
+
+
 def _reconcile(store: LogStore) -> None:
     """Crash recovery for the apply/log window: ops are serialized, so
     at most the LAST op-log entry can be logged-but-unapplied (leader
@@ -112,6 +120,13 @@ def _reconcile(store: LogStore) -> None:
             for p in item.payloads:
                 e = _decode_entry(p)
                 e.seq = item.lsn
+                if e.op == pb.OP_APPEND and not e.expect_lsn:
+                    # no idempotence marker: re-applying could
+                    # duplicate the batch — skipping risks at most one
+                    # missing apply, which the seq handshake surfaces
+                    log.warning("skipping reconcile of unverifiable "
+                                "append at seq %d", e.seq)
+                    continue
                 _apply(store, e)
     reader.stop_reading(OPLOG_ID)
 
@@ -191,7 +206,8 @@ class _Follower:
                             entries.append(e)
                     elif hasattr(item, "hi_lsn"):
                         gap_hi = max(gap_hi, item.hi_lsn)
-                if gap_hi and not entries:
+                if gap_hi and (not entries
+                               or entries[0].seq != want):
                     # the follower is below the op-log trim point:
                     # catch-up cannot reconstruct those ops. Stop
                     # replicating to it — operator re-bootstraps the
@@ -231,9 +247,11 @@ class ReplicatedStore(LogStore):
                  replication_factor: int = 2,
                  node_id: str | None = None):
         self.local = local
-        # unique by default: a follower rejects entries from a second
-        # leader by id, which only works if ids actually differ
-        self.node_id = node_id or f"leader-{uuid.uuid4().hex[:10]}"
+        # stable across restarts (persisted in the local store) AND
+        # unique per store: a follower rejects entries from a second
+        # leader by id, which only works if ids differ between stores
+        # but SURVIVE a leader restart
+        self.node_id = node_id or _stable_node_id(local)
         self.replication_factor = max(int(replication_factor), 1)
         self._stop = threading.Event()
         self._cond = threading.Condition()
@@ -466,6 +484,7 @@ class FollowerService:
         self._lock = threading.Lock()
         self._broken: BaseException | None = None
         self._leader_id: str | None = None
+        self._ops_since_trim = 0
         if not local.log_exists(OPLOG_ID):
             local.create_log(OPLOG_ID)
         _reconcile(local)
@@ -520,6 +539,13 @@ class FollowerService:
                         "logged: %s", self.node_id, e.seq, exc)
                     context.abort(grpc.StatusCode.INTERNAL,
                                   f"op-log append failed: {exc}")
+                self._ops_since_trim += 1
+            if self._ops_since_trim >= 512:
+                # the follower's op-log only backs _reconcile (last
+                # entry) and applied_seq (the tail): reclaim the rest
+                self._ops_since_trim = 0
+                if applied > 1:
+                    self.local.trim(OPLOG_ID, applied - 1)
             return pb.ReplicateResponse(applied_seq=applied)
 
     def ReplicaInfo(self, request, context):

@@ -322,3 +322,38 @@ def test_follower_rejects_second_leader():
                 assert err.code() == grpc.StatusCode.FAILED_PRECONDITION
     finally:
         server.stop(grace=1)
+
+
+def test_leader_restart_keeps_feeding_followers(tmp_path):
+    """A restarted leader keeps its persisted node id, so live
+    followers accept its entries instead of pinning the old identity."""
+    follower_store = open_store("mem://")
+    port = free_port()
+    server, svc = serve_follower(follower_store, f"127.0.0.1:{port}")
+    d = str(tmp_path / "lead")
+    try:
+        leader = ReplicatedStore(open_store(d), [f"127.0.0.1:{port}"],
+                                 replication_factor=2)
+        nid = leader.node_id
+        leader.create_log(11)
+        leader.append(11, b"one")
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and svc.applied_seq < leader.oplog_seq):
+            time.sleep(0.05)
+        leader.close()
+        # restart on the same store dir: same node id, follower accepts
+        leader = ReplicatedStore(open_store(d), [f"127.0.0.1:{port}"],
+                                 replication_factor=2)
+        assert leader.node_id == nid
+        leader.append(11, b"two")
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and svc.applied_seq < leader.oplog_seq):
+            time.sleep(0.05)
+        assert svc.applied_seq == leader.oplog_seq
+        assert log_contents(follower_store, 11) == \
+            log_contents(leader.local, 11)
+        leader.close()
+    finally:
+        server.stop(grace=1)
