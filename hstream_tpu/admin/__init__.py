@@ -101,6 +101,59 @@ def cmd_delete_stream(stub, args) -> list[dict]:
     return [{"deleted": args.name}]
 
 
+def _admin(stub, command: str, **kwargs) -> list[dict]:
+    """Store-ops verbs over SendAdminCommand (reference hstore-admin
+    trim/findTime/offsets, admin/app/cli.hs:56-69)."""
+    import json
+
+    from hstream_tpu.common import records as rec
+
+    resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+        command=command, args=rec.dict_to_struct(kwargs)))
+    out = json.loads(resp.result)
+    if isinstance(out, dict) and not out:
+        return []
+    if isinstance(out, dict) and out and all(
+            isinstance(v, dict) for v in out.values()):
+        return [{"key": k, **v} for k, v in sorted(out.items())]
+    if isinstance(out, dict):
+        return [out]
+    return list(out)
+
+
+def cmd_trim(stub, args) -> list[dict]:
+    return _admin(stub, "trim", stream=args.stream, lsn=args.lsn)
+
+
+def cmd_find_time(stub, args) -> list[dict]:
+    return _admin(stub, "find-time", stream=args.stream, ts_ms=args.ts_ms)
+
+
+def cmd_offsets(stub, args) -> list[dict]:
+    return _admin(stub, "offsets", stream=args.stream)
+
+
+def cmd_sub_lag(stub, args) -> list[dict]:
+    return _admin(stub, "sub-lag", subscription=args.id)
+
+
+def cmd_snapshots(stub, args) -> list[dict]:
+    return _admin(stub, "snapshots")
+
+
+def cmd_replicas(stub, args) -> list[dict]:
+    out = _admin(stub, "replicas")
+    if out and "followers" in out[0]:
+        fols = out[0]["followers"]
+        return ([{"role": out[0]["role"], **f} for f in fols]
+                or [{"role": out[0]["role"]}])
+    return out
+
+
+def cmd_assignments(stub, args) -> list[dict]:
+    return _admin(stub, "assignments")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "hstream-tpu-admin",
@@ -119,6 +172,20 @@ def main(argv=None) -> int:
     p.add_argument("id", help="query id, or 'all'")
     p = sub.add_parser("delete-stream")
     p.add_argument("name")
+    p = sub.add_parser("trim", help="drop records with lsn <= LSN")
+    p.add_argument("stream")
+    p.add_argument("lsn", type=int)
+    p = sub.add_parser("find-time",
+                       help="first lsn at/after an epoch-ms timestamp")
+    p.add_argument("stream")
+    p.add_argument("ts_ms", type=int)
+    p = sub.add_parser("offsets", help="trim point / tail lsn of a stream")
+    p.add_argument("stream")
+    p = sub.add_parser("sub-lag", help="consumer lag of a subscription")
+    p.add_argument("id")
+    sub.add_parser("snapshots", help="per-query state snapshot sizes")
+    sub.add_parser("replicas", help="store replication follower status")
+    sub.add_parser("assignments", help="query -> server scheduler records")
     args = ap.parse_args(argv)
 
     fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]

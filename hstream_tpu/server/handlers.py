@@ -51,6 +51,12 @@ from hstream_tpu.store.streams import StreamType
 
 log = get_logger("server")
 
+# LDQuery-lite internal tables (reference hs_ldquery.cpp): plain SQL
+# over server metadata through ExecuteQuery
+VIRTUAL_TABLES = frozenset({
+    "__streams__", "__queries__", "__subscriptions__", "__views__",
+    "__connectors__", "__stats__"})
+
 
 def unary(fn):
     @functools.wraps(fn)
@@ -562,6 +568,61 @@ class HStreamApiServicer:
         return rec.dict_to_struct(task.tracer.summary())
 
     @unary
+    def SendAdminCommand(self, request, context):
+        """Store-ops verbs (reference hstore-admin trim/findTime/
+        offsets + maintenance introspection, admin/app/cli.hs:56-69):
+        one JSON-in/JSON-out RPC backing `python -m hstream_tpu.admin`.
+        """
+        import json as _json
+
+        ctx = self.ctx
+        args = rec.struct_to_dict(request.args)
+        cmd = request.command
+
+        def stream_logid(name: str) -> int:
+            return ctx.streams.get_logid(name)
+
+        if cmd == "trim":
+            logid = stream_logid(args["stream"])
+            ctx.store.trim(logid, int(args["lsn"]))
+            out = {"stream": args["stream"],
+                   "trim_point": ctx.store.trim_point(logid)}
+        elif cmd == "find-time":
+            logid = stream_logid(args["stream"])
+            out = {"stream": args["stream"],
+                   "lsn": ctx.store.find_time(logid,
+                                              int(args["ts_ms"]))}
+        elif cmd == "offsets":
+            logid = stream_logid(args["stream"])
+            out = {"stream": args["stream"], "logid": logid,
+                   "trim_point": ctx.store.trim_point(logid),
+                   "tail_lsn": ctx.store.tail_lsn(logid),
+                   "is_empty": ctx.store.is_log_empty(logid)}
+        elif cmd == "sub-lag":
+            rt = ctx.subscriptions.get(args["subscription"])
+            tail = ctx.store.tail_lsn(rt.logid)
+            committed = rt.committed_lsn
+            out = {"subscription": args["subscription"],
+                   "stream": rt.meta.stream_name,
+                   "committed_lsn": committed, "tail_lsn": tail,
+                   "lag": max(0, tail - committed)}
+        elif cmd == "snapshots":
+            out = {}
+            for key in ctx.store.meta_list("qsnap/"):
+                blob = ctx.store.meta_get(key)
+                out[key[len("qsnap/"):]] = {
+                    "bytes": 0 if blob is None else len(blob)}
+        elif cmd == "replicas":
+            status = getattr(ctx.store, "follower_status", None)
+            out = {"role": "leader" if status else "single",
+                   "followers": status() if status else []}
+        elif cmd == "assignments":
+            out = scheduler.assignments(ctx)
+        else:
+            raise ServerError(f"unknown admin command {cmd!r}")
+        return pb.AdminCommandResponse(result=_json.dumps(out))
+
+    @unary
     def GetStats(self, request, context):
         """Expose the stats holder (counters + time-series rates) — the
         observability the reference keeps native-only
@@ -641,12 +702,82 @@ class HStreamApiServicer:
         if isinstance(plan, plans.ExplainPlan):
             return [{"explain": plan.text}]
         if isinstance(plan, plans.SelectViewPlan):
+            if plan.view in VIRTUAL_TABLES:
+                return self._select_virtual(plan)
             mat = ctx.views.get(plan.view)
             return serve_select_view(mat, plan.select)
         if isinstance(plan, plans.SelectPlan):
             raise ServerError(
                 "push queries (EMIT CHANGES) go through ExecutePushQuery")
         raise ServerError(f"cannot execute {type(plan).__name__}")
+
+    def _select_virtual(self, plan) -> list[dict[str, Any]]:
+        """LDQuery-lite (reference hs_ldquery.cpp:1-175): plain SQL —
+        WHERE + projections — over internal metadata tables exposed as
+        __streams__/__queries__/__subscriptions__/__views__/
+        __connectors__/__stats__. Same AST evaluation the view pull
+        path applies (views.serve_select_view), minus window slicing."""
+        from hstream_tpu.server.views import filter_rows, project_rows
+
+        select = plan.select
+        rows = filter_rows(self._virtual_rows(plan.view), select)
+        return project_rows(rows, select)
+
+    def _virtual_rows(self, table: str) -> list[dict[str, Any]]:
+        ctx = self.ctx
+        if table == "__streams__":
+            out = []
+            for name in ctx.streams.find_streams():
+                meta = ctx.streams.stream_meta(name)
+                logid = ctx.streams.get_logid(name)
+                out.append({
+                    "name": name, "logid": logid,
+                    "replication_factor":
+                        meta.get("replication_factor", 1),
+                    "tail_lsn": ctx.store.tail_lsn(logid),
+                    "trim_point": ctx.store.trim_point(logid)})
+            return out
+        if table == "__queries__":
+            return [{"id": q.query_id,
+                     "status": getattr(q.status, "name", str(q.status)),
+                     "type": q.query_type, "sink": q.sink,
+                     "created_ms": q.created_time_ms, "sql": q.sql}
+                    for q in ctx.persistence.get_queries()]
+        if table == "__subscriptions__":
+            out = []
+            for rt in ctx.subscriptions.list():
+                tail = ctx.store.tail_lsn(rt.logid)
+                out.append({"id": rt.sub_id,
+                            "stream": rt.meta.stream_name,
+                            "committed_lsn": rt.committed_lsn,
+                            "tail_lsn": tail,
+                            "lag": max(0, tail - rt.committed_lsn)})
+            return out
+        if table == "__views__":
+            return [{"name": n} for n in ctx.views.names()]
+        if table == "__connectors__":
+            return [{"id": c.connector_id,
+                     "status": getattr(c.status, "name", str(c.status)),
+                     "sql": c.sql}
+                    for c in ctx.persistence.get_connectors()]
+        if table == "__stats__":
+            from hstream_tpu.stats import (
+                PER_STREAM_COUNTERS,
+                PER_STREAM_TIME_SERIES,
+            )
+
+            live = set(ctx.streams.find_streams())
+            rows: dict[str, dict[str, Any]] = {}
+            for metric in PER_STREAM_COUNTERS:
+                for s, v in ctx.stats.stream_stat_getall(metric).items():
+                    if s in live:
+                        rows.setdefault(s, {"stream": s})[metric] = v
+            for metric, _levels in PER_STREAM_TIME_SERIES:
+                for s in rows:
+                    rows[s][f"{metric}_rate"] = \
+                        ctx.stats.time_series_peek_rate(metric, s)
+            return [rows[s] for s in sorted(rows)]
+        raise ServerError(f"unknown virtual table {table}")
 
     def _show(self, what: str) -> list[dict[str, Any]]:
         ctx = self.ctx

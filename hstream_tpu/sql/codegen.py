@@ -346,6 +346,35 @@ def _codegen(stmt: ast.Statement, sql: str) -> plans.Plan:
     raise SQLCodegenError(f"cannot lower {type(stmt).__name__}")
 
 
+def mesh_exclusion_reason(plan: plans.Plan) -> str | None:
+    """Why a plan cannot execute over the device mesh (None = shardable).
+    One predicate shared by the task runtime's gate and EXPLAIN, so the
+    single-chip fallback is always visible (SURVEY §2.3)."""
+    if not isinstance(plan, plans.SelectPlan):
+        sel = getattr(plan, "select", None)
+        if sel is None:
+            return "not a SELECT plan"
+        plan = sel
+    if plan.join is not None:
+        return ("stream-stream/table JOIN keeps two-sided host state; "
+                "the downstream aggregate runs single-chip")
+    from hstream_tpu.engine.plan import AggKind, AggregateNode
+    from hstream_tpu.engine.window import SessionWindow
+
+    node = plan.node
+    if isinstance(node, AggregateNode) and isinstance(node.window,
+                                                      SessionWindow):
+        return ("session windows merge-on-overlap on the host; "
+                "segmentation is vectorized but not mesh-sharded")
+    if not isinstance(node, AggregateNode):
+        return "stateless plans have no device state to shard"
+    if any(a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)
+           for a in node.aggs):
+        return ("TOPK/TOPK_DISTINCT planes have no elementwise shard "
+                "merge; the query runs single-chip")
+    return None
+
+
 def explain_text(plan: plans.Plan) -> str:
     """Render the task topology (reference ExecPlan.hs:80-119)."""
     if isinstance(plan, plans.SelectPlan):
@@ -380,6 +409,11 @@ def explain_text(plan: plans.Plan) -> str:
             else:
                 lines.insert(0, f"JOIN {plan.join.right.name} "
                                 f"WITHIN {plan.join.within.ms}ms")
+        reason = mesh_exclusion_reason(plan)
+        if reason is None:
+            lines.append("MESH: shardable (data x key) when --mesh is set")
+        else:
+            lines.append(f"MESH: single-chip — {reason}")
         return "\n".join(lines)
     if isinstance(plan, plans.CreateBySelectPlan):
         return (f"CREATE STREAM {plan.stream} AS\n"

@@ -1,0 +1,160 @@
+"""Admin store-ops verbs (SendAdminCommand), LDQuery-lite virtual
+tables, mesh-exclusion visibility, and k8s manifest sanity."""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+import grpc
+import pytest
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+from hstream_tpu.server.main import serve
+
+BASE = 1_700_000_000_000
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def server_stub():
+    server, ctx = serve("127.0.0.1", 0, "mem://")
+    channel = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(channel)
+    yield stub, ctx
+    channel.close()
+    server.stop(grace=1)
+    ctx.shutdown()
+
+
+def admin(stub, command, **kwargs):
+    resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+        command=command, args=rec.dict_to_struct(kwargs)))
+    return json.loads(resp.result)
+
+
+def append_rows(stub, stream, rows, ts):
+    req = pb.AppendRequest(stream_name=stream)
+    for row, t in zip(rows, ts):
+        req.records.append(rec.build_record(row, publish_time_ms=t))
+    return stub.Append(req)
+
+
+def test_offsets_trim_findtime(server_stub):
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="ops"))
+    for i in range(5):
+        append_rows(stub, "ops", [{"i": i}], [BASE + i * 1000])
+    off = admin(stub, "offsets", stream="ops")
+    assert off["tail_lsn"] == 5 and off["trim_point"] == 0
+    # find_time operates on APPEND time (store wall clock)
+    ft = admin(stub, "find-time", stream="ops", ts_ms=BASE)
+    assert ft["lsn"] == 1      # everything appended after BASE (2023)
+    far = admin(stub, "find-time", stream="ops",
+                ts_ms=int(time.time() * 1000) + 3_600_000)
+    assert far["lsn"] == 6     # tail+1: nothing that late
+    tr = admin(stub, "trim", stream="ops", lsn=2)
+    assert tr["trim_point"] == 2
+    off = admin(stub, "offsets", stream="ops")
+    assert off["trim_point"] == 2 and off["tail_lsn"] == 5
+
+
+def test_sub_lag(server_stub):
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="lagged"))
+    append_rows(stub, "lagged", [{"i": i} for i in range(4)],
+                [BASE + i for i in range(4)])
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="lagsub", stream_name="lagged"))
+    lag = admin(stub, "sub-lag", subscription="lagsub")
+    assert lag["tail_lsn"] == 1    # one appended batch = one LSN
+    assert lag["lag"] == 1 - lag["committed_lsn"]
+    got = stub.Fetch(pb.FetchRequest(subscription_id="lagsub",
+                                     timeout_ms=1000, max_size=10))
+    stub.Acknowledge(pb.AcknowledgeRequest(
+        subscription_id="lagsub",
+        ack_ids=[rr.record_id for rr in got.received_records]))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        lag = admin(stub, "sub-lag", subscription="lagsub")
+        if lag["lag"] == 0:
+            break
+        time.sleep(0.1)
+    assert lag["lag"] == 0
+
+
+def test_snapshots_and_replicas_and_assignments(server_stub):
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="snapsrc"))
+    q = stub.CreateQuery(pb.CreateQueryRequest(
+        query_text="SELECT k, COUNT(*) AS c FROM snapsrc GROUP BY k, "
+                   "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))
+    append_rows(stub, "snapsrc", [{"k": "a"}], [BASE])
+    # force a snapshot via terminate (graceful stop persists state)
+    stub.TerminateQueries(pb.TerminateQueriesRequest(query_ids=[q.id]))
+    snaps = admin(stub, "snapshots")
+    assert q.id in snaps and snaps[q.id]["bytes"] > 0
+    reps = admin(stub, "replicas")
+    assert reps["role"] == "single"
+    # assignments: the terminated query's record is dropped
+    assert q.id not in admin(stub, "assignments")
+
+
+def test_virtual_tables(server_stub):
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="vt1", replication_factor=2))
+    stub.CreateStream(pb.Stream(stream_name="vt2"))
+    append_rows(stub, "vt1", [{"x": 1}], [BASE])
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="SELECT name, tail_lsn FROM __streams__ "
+                  "WHERE replication_factor > 1;"))
+    rows = [rec.struct_to_dict(r) for r in out.result_set]
+    assert {r["name"] for r in rows} == {"vt1"}
+    assert rows[0]["tail_lsn"] == 1
+    assert "replication_factor" not in rows[0]  # projection applied
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="SELECT * FROM __queries__;"))
+    assert isinstance(out.result_set, object)
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="SELECT * FROM __stats__;"))
+    rows = [rec.struct_to_dict(r) for r in out.result_set]
+    assert any(r.get("stream") == "vt1" for r in rows)
+
+
+def test_explain_notes_mesh_exclusion(server_stub):
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="l1"))
+    stub.CreateStream(pb.Stream(stream_name="r1"))
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="EXPLAIN SELECT l1.k, COUNT(*) AS c FROM l1 "
+                  "INNER JOIN r1 WITHIN (INTERVAL 1 SECOND) "
+                  "ON l1.k = r1.k GROUP BY l1.k, "
+                  "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))
+    text = rec.struct_to_dict(out.result_set[0])["explain"]
+    assert "MESH: single-chip" in text and "JOIN" in text
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="EXPLAIN SELECT k, COUNT(*) AS c FROM l1 GROUP BY k, "
+                  "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))
+    text = rec.struct_to_dict(out.result_set[0])["explain"]
+    assert "MESH: shardable" in text
+
+
+def test_k8s_manifests_parse_and_reference_real_entrypoints():
+    import yaml
+
+    files = glob.glob(os.path.join(REPO, "k8s", "*.yaml"))
+    assert len(files) >= 4
+    cmds = []
+    for f in files:
+        for doc in yaml.safe_load_all(open(f)):
+            assert doc and "kind" in doc, f
+            tmpl = (doc.get("spec", {}).get("template", {})
+                    .get("spec", {}).get("containers", []))
+            for c in tmpl:
+                cmds.append((c.get("command", []), c.get("args", [])))
+    mods = [cmd[2] for cmd, _ in cmds if len(cmd) >= 3 and cmd[1] == "-m"]
+    assert "hstream_tpu.server.main" in mods
+    assert "hstream_tpu.store.replica" in mods
