@@ -393,7 +393,7 @@ def bench_snapshot_overhead() -> dict:
     from hstream_tpu.server.main import serve
 
     KEYS = 100_000
-    n, batches = 1 << 17, 8
+    n, batches = 1 << 16, 6
     rng = np.random.default_rng(7)
     base = 1_700_000_000_000
     devs = np.array([f"dev{k}" for k in range(KEYS)])
@@ -523,8 +523,9 @@ def server_path_eps() -> dict:
         out["server_columnar_eps"] = round(
             batches * n / (time.perf_counter() - t0))
 
-        # per-record JSON appends (the reference-style path); the first
-        # appends warm the coalesced-shape compile before timing
+        # per-record JSON appends (the reference-style path); warmup
+        # compiles BOTH coalesced step shapes the timed phase can hit:
+        # single-append polls (small cap) and burst coalesces (big cap)
         jn, jb, jwarm = 1000, 50, 10
         base2 = base + 10 * 60_000
         reqs = []
@@ -535,7 +536,10 @@ def server_path_eps() -> dict:
                     {"device": f"d{i % N_KEYS}", "temp": 21.5},
                     publish_time_ms=base2 + b * 200 + i // 5))
             reqs.append((base2 + b * 200 + (jn - 1) // 5, req))
-        for last, req in reqs[:jwarm]:
+        for last, req in reqs[:3]:          # slow: one append per poll
+            stub.Append(req)
+            drain_to(last)
+        for last, req in reqs[3:jwarm]:     # burst: big coalesce shape
             stub.Append(req)
         drain_to(reqs[jwarm - 1][0])
         t0 = time.perf_counter()
@@ -658,12 +662,16 @@ def main() -> None:
         "platform": jax.devices()[0].platform,
     }
     def safe(label, fn, *a):
+        t0 = time.perf_counter()
         try:
             return fn(*a)
         except Exception as e:  # noqa: BLE001 — keep the record partial
             print(f"# {label} failed: {type(e).__name__}: {e}",
                   flush=True)
             return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            print(f"# {label}: {time.perf_counter() - t0:.1f}s",
+                  flush=True)
 
     sp = safe("server_path", server_path_eps)
     if "error" in sp:

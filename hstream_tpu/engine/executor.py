@@ -190,6 +190,8 @@ class QueryExecutor:
         # work instead of stalling the loop (matters on high-RTT links).
         # Changes then lag emission by one micro-batch; flush_changes()
         # drains the tail.
+        self._caps_used: set[int] = set()  # compiled staged-step shapes
+        self._caps_lock = threading.Lock()
         self.defer_change_decode = False
         # how many change extracts may queue before a batched fetch; >1
         # amortizes the device->host round trip over many micro-batches
@@ -540,15 +542,33 @@ class QueryExecutor:
     def _stage_cap(self, n: int) -> int:
         """Padded capacity for a columnar micro-batch. Floored at 4096
         (or batch_capacity when smaller) so variable-size coalesced
-        batches share ONE compiled step shape — each distinct cap is a
-        separate XLA compile, and scatter cost at 4096 rows is noise."""
-        return round_up_pow2(n, lo=min(self.batch_capacity, 4096))
+        batches share compiled step shapes — each distinct cap is a
+        separate XLA compile (SECONDS on a tunneled backend), and
+        scatter cost on padded rows is noise. Sticky: a batch reuses
+        the smallest already-chosen cap that fits within 8x padding,
+        so varying coalesce sizes converge on a few shapes instead of
+        compiling each power of two they happen to hit. (A gap-guard
+        fallback can discard a chosen cap before its shape compiles —
+        at worst that costs one compile at a nearby size later.)
+
+        Called from both the pipeline's encoder thread and the task
+        thread (sync fallbacks): the lock keeps the set iteration and
+        the insert from racing."""
+        with self._caps_lock:
+            for c in sorted(self._caps_used):
+                if n <= c <= 8 * max(n, 1):
+                    return c
+            cap = round_up_pow2(n, lo=min(self.batch_capacity, 4096))
+            self._caps_used.add(cap)
+            return cap
 
     def _process_columnar(self, key_ids, ts_ms, cols, nulls
                           ) -> list[dict[str, Any]]:
         n = len(key_ids)
-        cap = self._stage_cap(n)
         if n > self.batch_capacity:
+            # split BEFORE choosing a staged cap: an oversize batch's
+            # cap would be registered but never compiled (the chunks
+            # compute their own), corrupting the sticky-cap cache
             out = []
             for i in range(0, n, self.batch_capacity):
                 sl = slice(i, i + self.batch_capacity)
@@ -558,6 +578,7 @@ class QueryExecutor:
                     None if nulls is None else
                     {k: v[sl] for k, v in nulls.items()}))
             return out
+        cap = self._stage_cap(n)
 
         ts_list = np.asarray(ts_ms, dtype=np.int64)
         min_ts, max_ts = int(ts_list.min()), int(ts_list.max())

@@ -552,9 +552,15 @@ class QueryTask(threading.Thread):
         snapshot-restore paths."""
         if getattr(ex, "emit_changes", False) and \
                 getattr(ex, "supports_deferred_changes", False):
-            # pipeline the changelog fetch behind the next batch's work;
-            # the idle tick flushes so rows lag <= one poll cycle
+            # pipeline changelog fetches behind later batches' work and
+            # fetch them in BATCHED device->host transfers: on a real
+            # link each fetch is a full round trip, which otherwise
+            # bounds sustained ingest at (batch size / RTT). The idle
+            # tick flushes everything pending, so emitted rows lag at
+            # most one poll cycle once ingest pauses — under sustained
+            # load they lag up to change_drain_depth micro-batches.
             ex.defer_change_decode = True
+            ex.change_drain_depth = 8
         return ex
 
     def _run_rows(self, rows: list, ts: list, logid: int | None) -> None:
