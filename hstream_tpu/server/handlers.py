@@ -45,7 +45,7 @@ from hstream_tpu.server.tasks import QueryTask, snapshot_key, stream_sink
 from hstream_tpu.server.views import Materialization, serve_select_view
 from hstream_tpu.sql import plans
 from hstream_tpu.sql.codegen import explain_text, stream_codegen
-from hstream_tpu.store.api import LSN_MIN, DataBatch
+from hstream_tpu.store.api import LSN_MIN, Compression, DataBatch
 from hstream_tpu.store.checkpoint import CheckpointedReader
 from hstream_tpu.store.streams import StreamType
 
@@ -145,7 +145,9 @@ class HStreamApiServicer:
             nbytes += len(data)
         if not payloads:
             raise ServerError("empty append")
-        lsn = ctx.store.append_batch(logid, payloads)
+        lsn = ctx.store.append_batch(
+            logid, payloads,
+            getattr(ctx, "append_compression", Compression.NONE))
         ctx.stats.note_append(request.stream_name, len(payloads), nbytes)
         out = pb.AppendResponse(stream_name=request.stream_name)
         for i in range(len(payloads)):

@@ -38,7 +38,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           segment_bytes: int | None = None,
           snapshot_interval_ms: int | None = None,
           replicate: str | None = None,
-          replication_factor: int = 2
+          replication_factor: int = 2,
+          append_compression: str | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -57,6 +58,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
             replication_factor=replication_factor)
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
     ctx = ServerContext(store, host=host, port=port, mesh=mesh)
+    if append_compression:
+        from hstream_tpu.store.api import Compression
+
+        ctx.append_compression = Compression[append_compression.upper()]
     if snapshot_interval_ms is not None:
         # per-context, not the QueryTask CLASS attribute: two servers in
         # one process must not leak cadence into each other's tasks
@@ -114,13 +119,17 @@ def _parse_args(argv):
                          "--replicate-factor onto LogDevice)")
     ap.add_argument("--replication-factor", type=int, default=None,
                     help="copies (incl. leader) an append waits for")
+    ap.add_argument("--append-compression", default=None,
+                    choices=["none", "zlib"],
+                    help="storage compression for appended batches "
+                         "(reference server.hs --compression)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
                 "workers": 32, "mesh": None, "log_level": None,
                 "sync_interval_ms": None, "segment_bytes": None,
                 "snapshot_interval_ms": None, "replicate": None,
-                "replication_factor": 2}
+                "replication_factor": 2, "append_compression": None}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -154,7 +163,8 @@ def main(argv=None) -> None:
         segment_bytes=cfg["segment_bytes"],
         snapshot_interval_ms=cfg["snapshot_interval_ms"],
         replicate=cfg["replicate"],
-        replication_factor=cfg["replication_factor"])
+        replication_factor=cfg["replication_factor"],
+        append_compression=cfg["append_compression"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

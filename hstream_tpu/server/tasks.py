@@ -779,9 +779,24 @@ def _columnar_key_ids(ex, cols: dict, n: int,
         # key-capacity grow.
         vals = col_vals[0]
         codes = col_codes[0]
+        # raw-value -> key id memo: at SURVEY-scale cardinality (100K+
+        # live keys) the per-distinct key_id_for canon+tuple work is
+        # ~100ms per batch; a dict hit is ~10x cheaper. kids never
+        # change once assigned, so the memo cannot go stale; it is
+        # bounded like the session key caches.
+        memo = getattr(ex, "_kid_vmemo", None)
+        if memo is None:
+            memo = ex._kid_vmemo = {}
+        elif len(memo) > (1 << 20):
+            memo.clear()
         kid_lut = np.zeros(len(vals), np.int32)
         for p in np.unique(codes).tolist():
-            kid_lut[p] = ex.key_id_for((vals[p],))
+            v = vals[p]
+            kid = memo.get(v)
+            if kid is None:
+                kid = ex.key_id_for((v,))
+                memo[v] = kid
+            kid_lut[p] = kid
         return kid_lut[codes]
     radix = 1
     for vals in col_vals:

@@ -158,3 +158,29 @@ def test_k8s_manifests_parse_and_reference_real_entrypoints():
     mods = [cmd[2] for cmd, _ in cmds if len(cmd) >= 3 and cmd[1] == "-m"]
     assert "hstream_tpu.server.main" in mods
     assert "hstream_tpu.store.replica" in mods
+
+
+def test_append_compression_knob():
+    """--append-compression zlib round-trips through the store (the
+    reference server.hs --compression flag)."""
+    from hstream_tpu.server.main import serve as _serve
+
+    server, ctx = _serve("127.0.0.1", 0, "mem://",
+                         append_compression="zlib")
+    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(ch)
+    try:
+        stub.CreateStream(pb.Stream(stream_name="z"))
+        append_rows(stub, "z", [{"v": "x" * 500}] * 8,
+                    [BASE + i for i in range(8)])
+        stub.CreateSubscription(pb.Subscription(
+            subscription_id="zs", stream_name="z"))
+        got = stub.Fetch(pb.FetchRequest(subscription_id="zs",
+                                         timeout_ms=2000, max_size=20))
+        rows = [rec.record_to_dict(rec.parse_record(r.record))
+                for r in got.received_records]
+        assert len(rows) == 8 and all(r["v"] == "x" * 500 for r in rows)
+    finally:
+        ch.close()
+        server.stop(grace=1)
+        ctx.shutdown()
