@@ -46,6 +46,7 @@ N_UNIQUE = 8               # distinct pre-generated batches, cycled
 WARMUP_BATCHES = 55        # spans one window close (compiles extract/reset)
 MEASURE_BATCHES = 100      # spans two window closes
 PIPELINE_DEPTH = 4
+ENCODE_WORKERS = 2         # host-encode worker pool (engine.pipeline)
 
 
 def build_executor():
@@ -215,7 +216,8 @@ def bench_config2_hop_multi() -> dict:
     ex.defer_close_decode = True
     for k in range(N_KEYS):
         ex.key_id_for((f"d{k}",))
-    pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH)
+    pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH,
+                          workers=ENCODE_WORKERS)
     src = BatchSource(seed=2)
     warm, meas = 12, 40
     for _ in range(warm):
@@ -301,23 +303,21 @@ def bench_config5_join_view() -> dict:
 
     joined = 0
     warm = 14
+    # pipeline the changelog fetches behind later batches' host work,
+    # fetch them in batched async device->host transfers (the knobs
+    # proxy through the join onto its downstream aggregate), and
+    # coalesce probe matches so each device step (a round trip) covers
+    # many input batches
+    ex.defer_change_decode = True
+    ex.change_drain_depth = 8
+    ex.async_change_drain = True
     for b in range(warm):  # warmup/compile (incl. coalesced step shapes)
         rows, ts = mk(b)
         ex.process(rows, ts, stream="l" if b % 2 else "r")
-        if b == 1 and ex._inner is not None and hasattr(
-                ex._inner, "defer_change_decode"):
-            # pipeline the changelog fetches behind later batches' host
-            # work and fetch them in batched device->host transfers —
-            # on a real link each fetch is a full round trip; coalesce
-            # probe matches so each device step (a round trip) covers
-            # many input batches
-            ex._inner.defer_change_decode = True
-            ex._inner.change_drain_depth = 8
+        if b == 1:
             ex.coalesce_rows = 1 << 15
-    ex.flush_staged()
-    if ex._inner is not None and hasattr(ex._inner, "flush_changes"):
-        ex._inner.flush_changes()
-        ex._inner.block_until_ready()
+    ex.flush_changes()
+    ex.block_until_ready()
     # best-of-2 sustained runs (same methodology as the headline): the
     # link's run-to-run spread otherwise swamps the engine's number
     best = None
@@ -329,9 +329,7 @@ def bench_config5_join_view() -> dict:
             rows, ts = mk(b)
             out = ex.process(rows, ts, stream="l" if b % 2 else "r")
             joined += len(out)
-        joined += len(ex.flush_staged())
-        if ex._inner is not None and hasattr(ex._inner, "flush_changes"):
-            joined += len(ex._inner.flush_changes())
+        joined += len(ex.flush_changes())  # staged matches + changes
         dt = time.perf_counter() - t0
         b0 += batches
         res = {"events_per_sec": round(batches * n / dt),
@@ -563,7 +561,8 @@ def main() -> None:
 
     ex = build_executor()
     src = BatchSource()
-    pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH)
+    pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH,
+                          workers=ENCODE_WORKERS)
 
     for _ in range(WARMUP_BATCHES):
         kids, ts, cols = src.next()
@@ -571,6 +570,7 @@ def main() -> None:
     pipe.flush()
     ex.drain_closed()
     force(ex)
+    pipe.reset_stats()  # stage occupancies cover the timed region only
 
     import contextlib
     import os
@@ -620,6 +620,11 @@ def main() -> None:
     if not runs:
         raise RuntimeError("all headline runs failed")
     eps, elapsed = max(runs)  # best run, with ITS measured wall time
+    # per-stage pipeline occupancy over the timed region: encode (host
+    # wire pack, summed over workers), upload wait (H2D double-buffer
+    # backpressure), step (ordered dispatch + bookkeeping), drain
+    # (deferred change/close decode)
+    pipeline_stages = {k: round(v, 4) for k, v in pipe.stats().items()}
 
     close_ms, close_dispatch_ms = measure_close_latency(ex, pipe, src)
     p99_close = (float(np.percentile(close_ms, 99)) if close_ms else None)
@@ -659,6 +664,9 @@ def main() -> None:
         "kernel_events_per_sec": round(kernel_eps),
         "wire_bytes_per_event": round(wire_bpe, 2),
         "rtt_ms": round(rtt_ms, 1),
+        "pipeline_depth": PIPELINE_DEPTH,
+        "encode_workers": ENCODE_WORKERS,
+        "pipeline_stages": pipeline_stages,
         "platform": jax.devices()[0].platform,
     }
     def safe(label, fn, *a):

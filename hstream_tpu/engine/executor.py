@@ -26,6 +26,9 @@ from __future__ import annotations
 
 import math
 import threading
+import time
+from collections import deque
+from concurrent import futures
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Sequence
 
@@ -57,6 +60,22 @@ from hstream_tpu.engine.window import FixedWindow, SessionWindow
 REBASE_THRESHOLD = 1 << 30  # re-anchor epoch when relative time passes this
 
 EmitFn = Callable[[list[dict[str, Any]]], None]
+
+# Shared device->host change-drain workers: ONE small pool for every
+# executor in the process, so N concurrent queries batch their blocking
+# D2H fetches onto drain threads instead of each stalling its own task
+# loop. 2 workers: one fetch can ride the link while another decodes.
+_DRAIN_POOL: futures.ThreadPoolExecutor | None = None
+_DRAIN_POOL_LOCK = threading.Lock()
+
+
+def _change_drain_pool() -> futures.ThreadPoolExecutor:
+    global _DRAIN_POOL
+    with _DRAIN_POOL_LOCK:
+        if _DRAIN_POOL is None:
+            _DRAIN_POOL = futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="change-drain")
+        return _DRAIN_POOL
 
 
 def _align_down(ts: int, step: int) -> int:
@@ -198,6 +217,23 @@ class QueryExecutor:
         # (changelog rows then lag ingest by up to `depth` batches)
         self.change_drain_depth = 1
         self._pending_changes: list[Any] = []
+        # Async change drain: batched change fetches run on the shared
+        # drain pool instead of the caller's thread, so the D2H round
+        # trip overlaps later batches' encode/step work entirely. Rows
+        # are collected strictly in submission order (FIFO head-pop),
+        # so emitted change order matches the synchronous path.
+        self.async_change_drain = False
+        self._drain_futs: deque = deque()
+        # double-buffered device staging: at most upload_slots H2D
+        # transfers in flight; staging a batch past that waits on the
+        # OLDEST outstanding transfer (classic double-buffer handoff)
+        self.upload_slots = 2
+        self._upload_ring: deque = deque()
+        self._upload_lock = threading.Lock()
+        # per-stage busy-seconds shared with IngestPipeline.stats()
+        self.stage_stats: dict[str, float] = {"upload_wait_s": 0.0,
+                                              "drain_s": 0.0}
+        self._stats_lock = threading.Lock()
 
     def _extract_filter(self) -> Expr | None:
         # Walk the child chain down to the source, ANDing every FilterNode
@@ -249,24 +285,32 @@ class QueryExecutor:
         combo, bases, words = self._encode_locked(
             cap, n, key_ids, ts_rel, cols, valid, null_streams)
         step = lattice.compiled_encoded_step(
-            self.spec, self.schema, self._filter_expr, combo, cap)
-        self.state = step(self.state, wm_rel, np.int32(n), bases, words)
+            self.spec, self.schema, self._filter_expr, combo, cap,
+            donate_words=True)
+        self.state = step(self.state, wm_rel, np.int32(n), bases,
+                          self._device_stage(words))
 
     def _encode_locked(self, cap, n, key_ids, ts_rel, cols, valid,
                        null_streams):
-        """Wire-encode under the transport lock (encoder thread vs sync
-        fallbacks). Null streams, once seen, stay on the wire (sticky) so
-        the encoding combo — and the compiled executable — is stable
-        batch-to-batch."""
+        """Wire-encode one batch. Only the sticky-null merge holds the
+        transport lock (a concurrent add during iteration would throw);
+        the encode itself runs UNLOCKED so a pool of pipeline encode
+        workers packs batches in parallel — safe because every batch's
+        (combo, bases, words) triple is self-consistent and the codec's
+        adaptive state tolerates racy updates (transport.BitpackTransport
+        thread-safety note). Null streams, once seen, stay on the wire
+        (sticky) so the encoding combo — and the compiled executable —
+        converges batch-to-batch."""
         with self._transport_lock:
             for nk in null_streams:
                 self._null_sticky.add(nk)
-            for nk in self._null_sticky:
-                if nk not in null_streams:
-                    null_streams[nk] = np.zeros(n, dtype=np.bool_)
-            return self._transport.encode(
-                cap, n, key_ids, ts_rel, cols, self._layout,
-                valid=valid, null_streams=null_streams)
+            sticky = tuple(self._null_sticky)
+        for nk in sticky:
+            if nk not in null_streams:
+                null_streams[nk] = np.zeros(n, dtype=np.bool_)
+        return self._transport.encode(
+            cap, n, key_ids, ts_rel, cols, self._layout,
+            valid=valid, null_streams=null_streams)
 
     # ---- keys --------------------------------------------------------------
 
@@ -622,6 +666,31 @@ class QueryExecutor:
 
     # ---- pipelined ingest (stage on one thread, step on another) ----------
 
+    def _device_stage(self, words):
+        """Double-buffered H2D staging: dispatch the async upload, then
+        bound in-flight transfers to `upload_slots` by waiting on the
+        OLDEST outstanding one (the classic double-buffer handoff). The
+        wait blocks an encode worker, never the step-dispatch thread,
+        so upload N+1 rides the link while batch N computes. Buffers
+        already consumed (donated) by a step are skipped — donation IS
+        the recycling of the staging slot."""
+        dev = jax.device_put(words)
+        wait = None
+        with self._upload_lock:
+            self._upload_ring.append(dev)
+            if len(self._upload_ring) > max(self.upload_slots, 1):
+                wait = self._upload_ring.popleft()
+        if wait is not None and not wait.is_deleted():
+            t0 = time.perf_counter()
+            try:
+                wait.block_until_ready()
+            except RuntimeError:
+                pass  # donated to a step between the check and the wait
+            with self._stats_lock:
+                self.stage_stats["upload_wait_s"] += \
+                    time.perf_counter() - t0
+        return dev
+
     def _null_valid_streams(self, n: int, nulls):
         null_streams: dict[str, np.ndarray] = {}
         if nulls is not None:
@@ -676,7 +745,7 @@ class QueryExecutor:
             staged.cap, n, key_ids, ts_rel64, cols, valid, null_streams)
         staged.combo = combo
         staged.bases = bases
-        staged.words = jax.device_put(words) if upload else words
+        staged.words = self._device_stage(words) if upload else words
         return staged
 
     def process_staged(self, staged: StagedBatch | None
@@ -719,7 +788,7 @@ class QueryExecutor:
                           if self.watermark_abs >= 0 else -1)
         step = lattice.compiled_encoded_step(
             self.spec, self.schema, self._filter_expr, staged.combo,
-            staged.cap)
+            staged.cap, donate_words=True)
         self.state = step(self.state, wm_rel, np.int32(staged.n),
                           staged.bases, staged.words)
 
@@ -794,20 +863,61 @@ class QueryExecutor:
         # the epoch is captured WITH the extract: a rebase between
         # extract and the deferred decode must not shift window bounds
         self._pending_changes.append((self.epoch, packed))
+        out = self._collect_drained(block=False)
         if len(self._pending_changes) <= max(self.change_drain_depth, 1):
-            return []
+            return out
         # keep the newest extract deferred (it pipelines behind the
         # next batch's work); fetch everything older in one transfer
         keep = self._pending_changes.pop()
-        rows = self._decode_pending(self._pending_changes)
+        batch = self._pending_changes
         self._pending_changes = [keep]
+        if self.async_change_drain:
+            # the blocking D2H fetch + row decode move to the shared
+            # drain pool; rows surface on later calls, in FIFO order
+            self._drain_futs.append(
+                _change_drain_pool().submit(self._drain_job, batch))
+            out.extend(self._collect_drained(block=False))
+        else:
+            out.extend(self._decode_pending(batch))
+        return out
+
+    def _drain_job(self, batch: list) -> list[dict[str, Any]]:
+        """One async drain unit (drain-pool thread). Reads only
+        append-only / immutable executor state: _key_rev grows
+        monotonically, spec.aggs never changes (grow_keys swaps n_keys
+        only), and the packed buffers are immutable device values."""
+        t0 = time.perf_counter()
+        try:
+            return self._decode_pending(batch)
+        finally:
+            with self._stats_lock:
+                self.stage_stats["drain_s"] += time.perf_counter() - t0
+
+    def _collect_drained(self, block: bool) -> list[dict[str, Any]]:
+        """Completed async drains, strictly in submission order (head
+        pop only — a done future behind an unfinished one waits, so
+        change rows never reorder). block=True takes everything."""
+        rows: list[dict[str, Any]] = []
+        while self._drain_futs:
+            f = self._drain_futs[0]
+            if not block and not f.done():
+                break
+            self._drain_futs.popleft()
+            rows.extend(f.result())
         return rows
 
     def flush_changes(self) -> list[dict[str, Any]]:
-        """Decode every deferred changelog extract (forces the queue)."""
-        rows = self._decode_pending(self._pending_changes)
+        """Decode every deferred changelog extract (forces the async
+        drain queue, then the still-pending tail)."""
+        rows = self._collect_drained(block=True)
+        rows.extend(self._decode_pending(self._pending_changes))
         self._pending_changes = []
         return rows
+
+    def has_pending_changes(self) -> bool:
+        """True when deferred change extracts (queued or in the async
+        drain) still hold undelivered rows."""
+        return bool(self._pending_changes or self._drain_futs)
 
     def _decode_pending(self, pending: list) -> list[dict[str, Any]]:
         """Decode deferred change extracts, fetching device buffers in

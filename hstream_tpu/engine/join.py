@@ -135,6 +135,14 @@ class _JoinBase:
         self._inner_plan = replace(plan, join=None)
         self._initial_keys = initial_keys
         self._batch_capacity = batch_capacity
+        # deferred-change tuning proxied onto the (lazily created) inner
+        # executor, so the server's _tune_executor and bench harnesses
+        # treat a join exactly like a plain aggregate: the downstream
+        # changelog extraction pipelines/batches instead of serializing
+        # the join's compute loop with one D2H fetch per micro-batch
+        self.emit_changes = bool(getattr(plan, "emit_changes", False))
+        self.supports_deferred_changes = True
+        self._inner_tuning: dict[str, object] = {}
 
     def _side_of(self, stream: str | None) -> str:
         if stream is None:
@@ -180,9 +188,70 @@ class _JoinBase:
                 self._inner_plan, sample_rows=joined,
                 initial_keys=self._initial_keys,
                 batch_capacity=self._batch_capacity)
+            self._apply_inner_tuning()
         return self._inner.process(joined, jts)
 
+    def _apply_inner_tuning(self) -> None:
+        inner = self._inner
+        if inner is None or not getattr(inner, "supports_deferred_changes",
+                                        False):
+            return
+        for k, v in self._inner_tuning.items():
+            setattr(inner, k, v)
+
+    def _proxy_tuning(self, name: str, value) -> None:
+        self._inner_tuning[name] = value
+        self._apply_inner_tuning()
+
+    # change-drain knobs ride through to the inner executor (set before
+    # OR after its lazy creation); reads fall back to the pending value
+    @property
+    def defer_change_decode(self) -> bool:
+        return bool(self._inner_tuning.get("defer_change_decode", False))
+
+    @defer_change_decode.setter
+    def defer_change_decode(self, v: bool) -> None:
+        self._proxy_tuning("defer_change_decode", bool(v))
+
+    @property
+    def change_drain_depth(self) -> int:
+        return int(self._inner_tuning.get("change_drain_depth", 1))
+
+    @change_drain_depth.setter
+    def change_drain_depth(self, v: int) -> None:
+        self._proxy_tuning("change_drain_depth", int(v))
+
+    @property
+    def async_change_drain(self) -> bool:
+        return bool(self._inner_tuning.get("async_change_drain", False))
+
+    @async_change_drain.setter
+    def async_change_drain(self, v: bool) -> None:
+        self._proxy_tuning("async_change_drain", bool(v))
+
     # ---- drains (API parity with QueryExecutor) ----------------------------
+
+    def flush_changes(self) -> list[dict[str, Any]]:
+        """Deliver every lagging emission: coalesced match rows staged
+        for the inner step first, then the inner executor's deferred
+        changelog extracts — the same barrier QueryExecutor exposes."""
+        rows = (self.flush_staged()
+                if hasattr(self, "flush_staged") else [])
+        inner = self._inner
+        if inner is not None and hasattr(inner, "flush_changes"):
+            rows.extend(inner.flush_changes())
+        return rows
+
+    def has_pending_changes(self) -> bool:
+        if getattr(self, "_staged_n", 0):
+            return True
+        inner = self._inner
+        if inner is None:
+            return False
+        hp = getattr(inner, "has_pending_changes", None)
+        if hp is not None:
+            return bool(hp())
+        return bool(getattr(inner, "_pending_changes", None))
 
     def peek(self) -> list[dict[str, Any]]:
         return [] if self._inner is None else self._inner.peek()

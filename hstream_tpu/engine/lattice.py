@@ -655,10 +655,16 @@ def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int,
 
 @functools.lru_cache(maxsize=2048)
 def compiled_encoded_step(spec: LatticeSpec, schema, filter_expr,
-                          combo, cap: int) -> Callable:
+                          combo, cap: int, *,
+                          donate_words: bool = False) -> Callable:
     """Cached jit of the v2-transport step for one encoding combo. The
     state argument is donated: steady-state ingest re-uses the lattice
-    buffers in place instead of allocating a fresh copy per micro-batch."""
+    buffers in place instead of allocating a fresh copy per micro-batch.
+    donate_words=True additionally donates the uploaded wire buffer (arg
+    4) — the ingest pipeline uses each staged buffer exactly once, so
+    donating it recycles the device staging slot for the next upload;
+    callers that re-dispatch one staged batch (kernel microbenchmarks)
+    must keep the default."""
     from hstream_tpu.engine.expr import compile_device
 
     agg_inputs, null_keys = compile_agg_inputs(spec, schema)
@@ -666,7 +672,9 @@ def compiled_encoded_step(spec: LatticeSpec, schema, filter_expr,
         else None
     # donation is a TPU/GPU optimization; CPU (the test backend) ignores
     # it with a warning per call, so only request it where it helps
-    donate = (0,) if jax.default_backend() != "cpu" else ()
+    donate: tuple[int, ...] = ()
+    if jax.default_backend() != "cpu":
+        donate = (0, 4) if donate_words else (0,)
     return jax.jit(build_step_encoded(spec, agg_inputs, filter_fn, combo,
                                       cap, null_keys),
                    donate_argnums=donate)

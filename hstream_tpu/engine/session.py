@@ -392,9 +392,21 @@ class SessionExecutor:
                     raw.append(eval_host(a.input, r))
                 except (TypeError, KeyError):
                     raw.append(None)
+        # one NULL rule for both engines: only int/float values count
+        # (matching _agg_input's isinstance check on the per-record slow
+        # path). A bare float64 asarray would silently coerce NUMERIC
+        # STRINGS here while the slow path NULLs them — the same record
+        # would then aggregate differently depending on lateness. The
+        # dtype probe keeps the all-numeric common case vectorized: any
+        # string/None/mixed value forces a non-numeric dtype and takes
+        # the per-element rule.
         try:
-            vals = np.asarray(raw, np.float64)
-        except (TypeError, ValueError):
+            arr = np.asarray(raw)
+        except (TypeError, ValueError):  # ragged sequences etc.
+            arr = None
+        if arr is not None and arr.dtype.kind in "fiub":
+            vals = arr.astype(np.float64)
+        else:
             vals = np.array(
                 [float(v) if isinstance(v, (int, float)) else np.nan
                  for v in raw], np.float64)

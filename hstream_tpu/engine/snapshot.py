@@ -172,9 +172,11 @@ def _lattice_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
     if ex._pending_closes:
         raise SQLCodegenError(
             "snapshot with deferred closes pending; drain_closed() first")
-    if getattr(ex, "_pending_changes", None):
+    if getattr(ex, "_pending_changes", None) \
+            or getattr(ex, "_drain_futs", None):
         # the touched mask was already cleared on device: the queued
-        # extracts are the ONLY copy of those change rows
+        # extracts (and any in-flight async drains) are the ONLY copy
+        # of those change rows
         raise SQLCodegenError(
             "snapshot with deferred changes pending; flush_changes() "
             "first")

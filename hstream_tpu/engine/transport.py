@@ -250,6 +250,15 @@ class BitpackTransport:
     recompiles — is bounded over a query's lifetime. The per-element
     passes (stats, quantize, pack) run in the native codec kernels
     (cpp/encode.cpp) when buildable, with pure-numpy fallbacks.
+
+    Thread-safety: encode() may be called CONCURRENTLY from several
+    pipeline encode workers without a lock. Each call's returned
+    (combo, bases, words) triple is built only from call-local state,
+    so every batch is self-describing regardless of interleaving; the
+    adaptive dicts/sets (_bits, _dec_scale, _demoted, ...) are touched
+    only via single GIL-atomic get/set/add ops, and a racy lost update
+    merely delays a sticky widening/demotion by one batch (costing at
+    most one extra jit specialization later, never a wrong decode).
     """
 
     def __init__(self) -> None:
@@ -309,7 +318,10 @@ class BitpackTransport:
         if name in self._demoted:
             return StreamPlan(name, ENC_RAW_F32), 0, vals
         lib = _lib()
-        scales = [self._dec_scale[name]] if name in self._dec_scale \
+        # single atomic read: a concurrent encode worker demoting this
+        # column pops the scale between a `in` check and a subscript
+        sticky_scale = self._dec_scale.get(name)
+        scales = [sticky_scale] if sticky_scale is not None \
             else list(DEC_SCALES)
         # all-f32 quantization; any rounding discrepancy vs a wider path
         # is caught by the round-trip verification, the actual guarantee

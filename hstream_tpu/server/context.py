@@ -22,17 +22,30 @@ from hstream_tpu.store.api import LogStore
 from hstream_tpu.store.checkpoint import LogCheckpointStore
 from hstream_tpu.store.streams import StreamApi
 
+# canonical overlapped-ingest defaults; every consumer (serve() flags,
+# QueryTask fallbacks) imports these so they cannot drift
+DEFAULT_PIPELINE_DEPTH = 4
+DEFAULT_ENCODE_WORKERS = 2
+
 
 class ServerContext:
     def __init__(self, store: LogStore, *,
                  persistence: Persistence | None = None,
                  host: str = "127.0.0.1", port: int = 6570,
                  server_id: int = 1, durable_meta: bool = True,
-                 mesh=None):
+                 mesh=None,
+                 pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+                 encode_workers: int = DEFAULT_ENCODE_WORKERS):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
         self.mesh = mesh
+        # overlapped-ingest tuning shared by every query task: staging
+        # ring depth (batches encoded ahead of the ordered step loop)
+        # and host-encode worker count (server --pipeline-depth /
+        # --encode-workers)
+        self.pipeline_depth = max(int(pipeline_depth), 1)
+        self.encode_workers = max(int(encode_workers), 1)
         self.streams = StreamApi(store)
         self.streams.ensure_checkpoint_log()
         self.ckp_store = LogCheckpointStore(store)

@@ -96,6 +96,17 @@ def _struct(row: dict[str, Any]) -> struct_pb2.Struct:
     return rec.dict_to_struct(row)
 
 
+def _reject_virtual_name(kind: str, name: str) -> None:
+    """CREATE STREAM/VIEW names must not shadow the reserved virtual
+    tables: a user view named __streams__ would be unreachable (SELECT
+    routes virtual names to metadata) and a stream of that name would
+    silently split reads between the two."""
+    if name in VIRTUAL_TABLES:
+        raise ServerError(
+            f"{kind} name {name!r} collides with a reserved virtual "
+            f"table; pick another name")
+
+
 class HStreamApiServicer:
     def __init__(self, ctx: ServerContext):
         self.ctx = ctx
@@ -110,6 +121,7 @@ class HStreamApiServicer:
 
     @unary
     def CreateStream(self, request, context):
+        _reject_virtual_name("stream", request.stream_name)
         self.ctx.streams.create_stream(
             request.stream_name,
             replication_factor=max(request.replication_factor, 1))
@@ -165,6 +177,7 @@ class HStreamApiServicer:
         else:
             raise ServerError("CreateQueryStream needs a SELECT statement")
         name = request.query_stream.stream_name
+        _reject_virtual_name("stream", name)
         self.ctx.streams.create_stream(
             name,
             replication_factor=max(request.query_stream.replication_factor,
@@ -253,6 +266,8 @@ class HStreamApiServicer:
             raise ServerError("CreateQuery expects SELECT ... EMIT CHANGES")
         query_id = request.id or f"q{gen_unique()}"
         sink_name = query_id
+        # request.id is user-supplied and becomes the sink STREAM name
+        _reject_virtual_name("stream", sink_name)
         self.ctx.streams.create_stream(sink_name,
                                        stream_type=StreamType.TEMP)
         info = self._launch_query(plan, request.query_text, QUERY_PUSH,
@@ -563,11 +578,17 @@ class HStreamApiServicer:
     @unary
     def GetQueryTrace(self, request, context):
         """Per-stage timing summary of a RUNNING query (decode /
-        key_encode / step / emit / snapshot rings — SURVEY §5.1)."""
+        key_encode / step / emit / snapshot rings — SURVEY §5.1), plus
+        the overlapped-ingest pipeline's stage occupancy when the query
+        runs the staged columnar path."""
         task = self.ctx.running_queries.get(request.id)
         if task is None:
             raise QueryNotFound(request.id)
-        return rec.dict_to_struct(task.tracer.summary())
+        out = task.tracer.summary()
+        pipe = getattr(task, "_pipe", None)
+        if pipe is not None:
+            out["pipeline"] = pipe.stats()
+        return rec.dict_to_struct(out)
 
     @unary
     def SendAdminCommand(self, request, context):
@@ -665,9 +686,11 @@ class HStreamApiServicer:
     def _execute_plan(self, plan, sql: str) -> list[dict[str, Any]]:
         ctx = self.ctx
         if isinstance(plan, plans.CreatePlan):
+            _reject_virtual_name("stream", plan.stream)
             ctx.streams.create_stream(plan.stream)
             return [{"stream": plan.stream, "created": True}]
         if isinstance(plan, plans.CreateBySelectPlan):
+            _reject_virtual_name("stream", plan.stream)
             ctx.streams.create_stream(plan.stream)
             info = self._launch_query(plan.select, sql, QUERY_STREAM,
                                       sink_stream=plan.stream)
@@ -704,7 +727,11 @@ class HStreamApiServicer:
         if isinstance(plan, plans.ExplainPlan):
             return [{"explain": plan.text}]
         if isinstance(plan, plans.SelectViewPlan):
-            if plan.view in VIRTUAL_TABLES:
+            # a pre-existing user view of a reserved name (created
+            # before the collision guard) keeps winning the route —
+            # rejecting creation must not orphan restored state
+            if plan.view in VIRTUAL_TABLES \
+                    and plan.view not in ctx.views.names():
                 return self._select_virtual(plan)
             mat = ctx.views.get(plan.view)
             return serve_select_view(mat, plan.select)
@@ -937,6 +964,7 @@ class HStreamApiServicer:
     def _create_view(self, plan: plans.CreateViewPlan,
                      sql: str) -> QueryInfo:
         ctx = self.ctx
+        _reject_virtual_name("view", plan.view)
         self._check_columns_against_stream(plan.select)
         query_id = f"view-{plan.view}"
         info = QueryInfo(query_id=query_id, sql=sql,

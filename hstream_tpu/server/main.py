@@ -17,7 +17,11 @@ import grpc
 
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.proto.rpc import add_hstream_api_to_server
-from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server.context import (
+    DEFAULT_ENCODE_WORKERS,
+    DEFAULT_PIPELINE_DEPTH,
+    ServerContext,
+)
 from hstream_tpu.store import open_store
 
 log = get_logger("main")
@@ -39,7 +43,9 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           snapshot_interval_ms: int | None = None,
           replicate: str | None = None,
           replication_factor: int = 2,
-          append_compression: str | None = None
+          append_compression: str | None = None,
+          pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+          encode_workers: int = DEFAULT_ENCODE_WORKERS
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -57,7 +63,9 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
             store, [a.strip() for a in replicate.split(",") if a.strip()],
             replication_factor=replication_factor)
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
-    ctx = ServerContext(store, host=host, port=port, mesh=mesh)
+    ctx = ServerContext(store, host=host, port=port, mesh=mesh,
+                        pipeline_depth=pipeline_depth,
+                        encode_workers=encode_workers)
     if append_compression:
         from hstream_tpu.store.api import Compression
 
@@ -123,13 +131,23 @@ def _parse_args(argv):
                     choices=["none", "zlib"],
                     help="storage compression for appended batches "
                          "(reference server.hs --compression)")
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    help="ingest staging-ring depth: micro-batches "
+                         "wire-encoded ahead of the ordered device "
+                         f"step loop (default {DEFAULT_PIPELINE_DEPTH})")
+    ap.add_argument("--encode-workers", type=int, default=None,
+                    help="host-encode worker threads per query task "
+                         "feeding the staging ring (default "
+                         f"{DEFAULT_ENCODE_WORKERS})")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
                 "workers": 32, "mesh": None, "log_level": None,
                 "sync_interval_ms": None, "segment_bytes": None,
                 "snapshot_interval_ms": None, "replicate": None,
-                "replication_factor": 2, "append_compression": None}
+                "replication_factor": 2, "append_compression": None,
+                "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
+                "encode_workers": DEFAULT_ENCODE_WORKERS}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -164,7 +182,9 @@ def main(argv=None) -> None:
         snapshot_interval_ms=cfg["snapshot_interval_ms"],
         replicate=cfg["replicate"],
         replication_factor=cfg["replication_factor"],
-        append_compression=cfg["append_compression"])
+        append_compression=cfg["append_compression"],
+        pipeline_depth=cfg["pipeline_depth"],
+        encode_workers=cfg["encode_workers"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -21,6 +21,7 @@ after the last snapshot.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import traceback
@@ -38,6 +39,10 @@ from hstream_tpu.engine.snapshot import (
     restore_executor,
     serialize_capture,
 )
+from hstream_tpu.server.context import (
+    DEFAULT_ENCODE_WORKERS,
+    DEFAULT_PIPELINE_DEPTH,
+)
 from hstream_tpu.server.persistence import QueryInfo, TaskStatus
 from hstream_tpu.store.api import LSN_MIN, DataBatch
 from hstream_tpu.store.checkpoint import CheckpointedReader
@@ -49,7 +54,7 @@ SinkFn = Callable[[list[dict[str, Any]]], None]
 
 READ_CHUNK = 2048
 POLL_TIMEOUT_MS = 50
-PIPELINE_DEPTH = 4
+PREFETCH_BATCHES = 2  # read-ahead depth of the reader prefetch thread
 
 
 def snapshot_key(query_id: str) -> str:
@@ -96,12 +101,21 @@ class QueryTask(threading.Thread):
         for name in self.source_streams():
             self._sources[ctx.streams.get_logid(name)] = name
         self._reader: CheckpointedReader | None = None
-        # double-buffered ingest: wire-encode + upload on a worker
-        # thread while this thread dispatches earlier batches' steps
-        # (engine.pipeline); created lazily for executors with a staged
-        # columnar path (plain aggregates — joins/sessions stay on the
-        # row path)
+        # overlapped ingest: wire-encode + upload on a pool of worker
+        # threads while this thread dispatches earlier batches' steps
+        # in order (engine.pipeline); created lazily for executors with
+        # a staged columnar path (plain aggregates — joins/sessions
+        # stay on the row path)
         self._pipe: IngestPipeline | None = None
+        self.pipeline_depth = int(getattr(ctx, "pipeline_depth",
+                                          DEFAULT_PIPELINE_DEPTH))
+        self.encode_workers = int(getattr(ctx, "encode_workers",
+                                          DEFAULT_ENCODE_WORKERS))
+        # reader prefetch (the HStreamDB layer-0/1 producer/consumer
+        # split): a read-ahead thread polls the store so JSON decode +
+        # encode of chunk N+1 overlaps the device work of chunk N
+        self._read_q: queue.Queue = queue.Queue(maxsize=PREFETCH_BATCHES)
+        self._read_thread: threading.Thread | None = None
         # always-on per-stage timing rings (SURVEY §5.1)
         self.tracer = QueryTracer()
         self._pending_ckps: dict[int, int] = {}  # processed, not committed
@@ -167,8 +181,18 @@ class QueryTask(threading.Thread):
             ctx.persistence.set_query_status(self.info.query_id,
                                              TaskStatus.RUNNING)
             self.attached.set()
+            self._read_thread = threading.Thread(
+                target=self._read_loop, args=(reader,),
+                name=f"read-{self.info.query_id}", daemon=True)
+            self._read_thread.start()
             while not self._stop_ev.is_set():
-                results = reader.read(READ_CHUNK)
+                try:
+                    results = self._read_q.get(
+                        timeout=POLL_TIMEOUT_MS / 1000)
+                except queue.Empty:
+                    results = None
+                if isinstance(results, BaseException):
+                    raise results  # reader died on the prefetch thread
                 if not results:
                     # idle tick: finish any staged-but-unprocessed
                     # batches so emitted rows lag ingest by at most one
@@ -203,6 +227,12 @@ class QueryTask(threading.Thread):
             except Exception:
                 pass
         finally:
+            t = self._read_thread
+            if t is not None:
+                # the prefetch thread watches _stop_ev; reap it BEFORE
+                # the persist worker so no reader call races teardown
+                self._stop_ev.set()
+                t.join(timeout=10)
             with self._persist_cv:
                 self._persist_stop = True
                 self._persist_cv.notify_all()
@@ -215,6 +245,27 @@ class QueryTask(threading.Thread):
             if self._pipe is not None:
                 self._pipe.close()
             ctx.running_queries.pop(self.info.query_id, None)
+
+    def _read_loop(self, reader: CheckpointedReader) -> None:
+        """Prefetch thread: poll the store ahead of the ingest loop so
+        the next chunk's bytes are in hand while the current chunk
+        decodes/encodes/computes. Read errors travel to the task thread
+        as a sentinel (raised at its next get). Only reader.read runs
+        here — checkpoint writes stay on the task/persist threads."""
+        while not self._stop_ev.is_set():
+            try:
+                results = reader.read(READ_CHUNK)
+            except BaseException as e:  # noqa: BLE001 — surfaced on
+                # the task thread; this thread must not die silently
+                results = e
+            while not self._stop_ev.is_set():
+                try:
+                    self._read_q.put(results, timeout=0.25)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(results, BaseException):
+                return
 
     # ---- operator-state checkpointing --------------------------------------
 
@@ -244,10 +295,16 @@ class QueryTask(threading.Thread):
         return ckps
 
     def _flush_deferred_changes(self) -> None:
-        """Drain deferred changelog extracts to the sink (idle ticks and
-        pre-snapshot — the snapshot guard requires an empty queue)."""
+        """Drain deferred changelog extracts (queued, async-drain, or
+        join-coalesced) to the sink — idle ticks and pre-snapshot; the
+        snapshot guard requires an empty queue."""
         ex = self.executor
-        if ex is None or not getattr(ex, "_pending_changes", None):
+        if ex is None:
+            return
+        hp = getattr(ex, "has_pending_changes", None)
+        pending = (hp() if hp is not None
+                   else bool(getattr(ex, "_pending_changes", None)))
+        if not pending:
             return
         with self.state_lock:
             rows = ex.flush_changes()
@@ -559,8 +616,13 @@ class QueryTask(threading.Thread):
             # tick flushes everything pending, so emitted rows lag at
             # most one poll cycle once ingest pauses — under sustained
             # load they lag up to change_drain_depth micro-batches.
+            # async_change_drain moves the batched fetch itself onto
+            # the shared drain pool, so even the amortized round trip
+            # stops serializing the compute loop. Join executors proxy
+            # these knobs onto their downstream aggregate.
             ex.defer_change_decode = True
             ex.change_drain_depth = 8
+            ex.async_change_drain = True
         return ex
 
     def _run_rows(self, rows: list, ts: list, logid: int | None) -> None:
@@ -638,7 +700,8 @@ class QueryTask(threading.Thread):
         submission by at most the pipeline depth; _drain_pipe() (idle
         tick / snapshot barrier) flushes the tail."""
         if self._pipe is None:
-            self._pipe = IngestPipeline(ex, depth=PIPELINE_DEPTH)
+            self._pipe = IngestPipeline(ex, depth=self.pipeline_depth,
+                                        workers=self.encode_workers)
         with trace_span(self.tracer, "step"):
             out = self._pipe.submit(key_ids, ts, cols, nulls)
         if out:

@@ -256,6 +256,14 @@ class ReplicatedStore(LogStore):
         self._stop = threading.Event()
         self._cond = threading.Condition()
         self._broken: BaseException | None = None
+        # durability introspection: status of the most recent acked
+        # append ("replicated" | "degraded:followers_down" |
+        # "degraded:timeout") + a monotone degraded counter, so callers
+        # can assert what an ack actually meant instead of trusting the
+        # normal return (ISSUE 1: a timed-out ack used to look fully
+        # replicated)
+        self.last_ack_status: str = "replicated"
+        self.degraded_appends: int = 0
         self._async_pool = futures.ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="repl-ack")
         self._ops_since_trim = 0
@@ -308,8 +316,14 @@ class ReplicatedStore(LogStore):
             self._wait_acks(seq)
 
     def follower_status(self) -> list[dict]:
+        """Per-follower liveness/lag plus the store-level ack status on
+        every entry, so one call answers both "who is behind" and "was
+        the last ack degraded"."""
         return [{"addr": f.addr, "alive": f.alive,
-                 "acked_seq": f.acked_seq}
+                 "acked_seq": f.acked_seq,
+                 "behind": max(0, self._seq - f.acked_seq),
+                 "last_ack_status": self.last_ack_status,
+                 "degraded_appends": self.degraded_appends}
                 for f in self._followers]
 
     @property
@@ -357,19 +371,37 @@ class ReplicatedStore(LogStore):
         if low > self.local.trim_point(OPLOG_ID):
             self.local.trim(OPLOG_ID, low)
 
-    def _wait_acks(self, seq: int) -> None:
+    def _wait_acks(self, seq: int) -> str:
+        """Wait for the replication quorum; returns the DURABILITY the
+        ack actually achieved — "replicated" when `need` followers
+        applied the op, else a degraded status ("degraded:
+        followers_down" / "degraded:timeout"). A degraded return is
+        recorded (last_ack_status, degraded_appends) so callers and
+        tests can assert it instead of mistaking availability for full
+        replication."""
+        status = self._wait_acks_inner(seq)
+        # under the lock: async-append pool threads and callers wait
+        # acks concurrently, and a lost increment would undercount
+        # degraded events exactly when the cluster is degraded
+        with self._cond:
+            self.last_ack_status = status
+            if status != "replicated":
+                self.degraded_appends += 1
+        return status
+
+    def _wait_acks_inner(self, seq: int) -> str:
         if not self._followers:
-            return
+            return "replicated"
         need = min(self.replication_factor - 1, len(self._followers))
         if need <= 0:
-            return
+            return "replicated"
         deadline = time.monotonic() + _ACK_TIMEOUT_S
         with self._cond:
             while True:
                 acked = sum(1 for f in self._followers
                             if f.acked_seq >= seq)
                 if acked >= need:
-                    return
+                    return "replicated"
                 live = sum(1 for f in self._followers if f.alive)
                 if acked >= live:
                     if live < need:
@@ -377,12 +409,12 @@ class ReplicatedStore(LogStore):
                             "replication degraded: %d/%d followers "
                             "live; seq %d acked by %d", live,
                             len(self._followers), seq, acked)
-                        return
+                        return "degraded:followers_down"
                 if time.monotonic() > deadline:
                     log.warning(
                         "replication ack timeout at seq %d (%d/%d)",
                         seq, acked, need)
-                    return
+                    return "degraded:timeout"
                 self._cond.wait(0.2)
 
     def trim(self, logid: int, up_to_lsn: int) -> None:
@@ -483,7 +515,12 @@ class FollowerService:
         self.node_id = node_id
         self._lock = threading.Lock()
         self._broken: BaseException | None = None
-        self._leader_id: str | None = None
+        # the accepted leader binding is DURABLE (store meta): a
+        # restarted follower must keep rejecting a stale leader instead
+        # of re-accepting whichever connects first after the restart
+        raw = local.meta_get("replica/leader_id")
+        self._leader_id: str | None = (raw.decode() if raw is not None
+                                       else None)
         self._ops_since_trim = 0
         if not local.log_exists(OPLOG_ID):
             local.create_log(OPLOG_ID)
@@ -503,6 +540,8 @@ class FollowerService:
             if request.leader_id:
                 if self._leader_id is None:
                     self._leader_id = request.leader_id
+                    self.local.meta_put("replica/leader_id",
+                                        request.leader_id.encode())
                 elif self._leader_id != request.leader_id:
                     # two leaders feeding one follower is operator
                     # error; acking both would silently diverge them

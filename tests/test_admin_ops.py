@@ -124,6 +124,55 @@ def test_virtual_tables(server_stub):
     assert any(r.get("stream") == "vt1" for r in rows)
 
 
+def test_virtual_table_names_are_reserved(server_stub):
+    """CREATE STREAM/VIEW colliding with a virtual table is rejected
+    (a user view named __streams__ would be unreachable); a user view
+    that ALREADY exists under a reserved name (pre-guard state) keeps
+    winning the SELECT route (ISSUE 1 satellite)."""
+    from hstream_tpu.server.views import Materialization
+
+    stub, ctx = server_stub
+    with pytest.raises(grpc.RpcError) as e:
+        stub.CreateStream(pb.Stream(stream_name="__streams__"))
+    assert e.value.code() == grpc.StatusCode.INTERNAL
+    assert "reserved" in e.value.details()
+    with pytest.raises(grpc.RpcError) as e:
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text="CREATE STREAM __queries__ AS SELECT x FROM vt1;"))
+    assert "reserved" in e.value.details()
+    with pytest.raises(grpc.RpcError) as e:
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text="CREATE VIEW __views__ AS SELECT x, COUNT(*) AS c "
+                      "FROM vt1 GROUP BY x, "
+                      "TUMBLING (INTERVAL 10 SECOND);"))
+    assert "reserved" in e.value.details()
+    assert "__views__" not in ctx.views.names()
+    # CreateQuery's user-supplied id becomes the sink STREAM name
+    with pytest.raises(grpc.RpcError) as e:
+        stub.CreateQuery(pb.CreateQueryRequest(
+            id="__streams__",
+            query_text="SELECT x, COUNT(*) AS c FROM vt1 GROUP BY x, "
+                       "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))
+    assert "reserved" in e.value.details()
+    # pre-existing user view under a reserved name: SELECT routes to IT,
+    # not to the virtual table
+    mat = Materialization(group_cols=["g"])
+    mat.add_closed([{"g": "legacy", "c": 7}])
+    ctx.views.register("__stats__", mat)
+    try:
+        out = stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text="SELECT * FROM __stats__;"))
+        rows = [rec.struct_to_dict(r) for r in out.result_set]
+        assert rows == [{"g": "legacy", "c": 7}]
+    finally:
+        ctx.views.remove("__stats__")
+    # with the view gone the virtual table answers again
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="SELECT * FROM __stats__;"))
+    rows = [rec.struct_to_dict(r) for r in out.result_set]
+    assert any(r.get("stream") == "vt1" for r in rows)
+
+
 def test_explain_notes_mesh_exclusion(server_stub):
     stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="l1"))

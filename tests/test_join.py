@@ -73,6 +73,63 @@ def test_join_groupby_window_aggregate():
     assert rows["la"]["total"] == pytest.approx(10.0)
 
 
+def test_join_deferred_async_changes_match_sync():
+    """The change-drain knobs proxy through the join onto its inner
+    aggregate (set BEFORE the inner lazily exists): deferred + async +
+    coalesced emission must match the synchronous join changelog after
+    flush_changes() (ISSUE 1: join change extraction off the hot loop)."""
+    import numpy as np
+
+    sql = ("SELECT l.k, COUNT(*) AS c, SUM(l.x) AS s FROM l INNER JOIN r "
+           "WITHIN (INTERVAL 1 SECOND) ON l.k = r.k "
+           "GROUP BY l.k, TUMBLING (INTERVAL 10 SECOND) "
+           "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
+    rng = np.random.default_rng(11)
+    batches = []
+    for b in range(12):
+        rows = [{"k": f"k{int(i)}", "x": 1.0}
+                for i in rng.integers(0, 50, 256)]
+        ts = [BASE + b * 500 + i % 500 for i in range(256)]
+        batches.append((rows, ts, "l" if b % 2 else "r"))
+
+    def run(tune: bool):
+        ex = make_join_executor(sql, [{"k": "k0", "x": 1.0}])
+        if tune:
+            # before the inner executor exists — must still apply
+            ex.defer_change_decode = True
+            ex.change_drain_depth = 3
+            ex.async_change_drain = True
+            ex.coalesce_rows = 1024
+        out = []
+        for rows, ts, side in batches:
+            out.extend(ex.process(rows, ts, stream=side))
+        out.extend(ex.flush_changes())
+        assert not ex.has_pending_changes()
+        if tune:
+            assert ex._inner is not None
+            assert ex._inner.defer_change_decode is True
+            assert ex._inner.async_change_drain is True
+        return out
+
+    sync_rows = run(False)
+    tuned_rows = run(True)
+    assert len(sync_rows) > 0
+
+    def canon(rows):
+        return sorted((r["l.k"], r["winStart"], r["c"], r["s"])
+                      for r in rows)
+
+    # coalescing merges micro-batches, so per-batch change cadence
+    # differs; the FINAL change per (key, window) must agree
+    def final(rows):
+        last = {}
+        for r in rows:
+            last[(r["l.k"], r["winStart"])] = (r["c"], r["s"])
+        return last
+
+    assert final(sync_rows) == final(tuned_rows)
+
+
 def test_join_timestamp_is_max_of_pair():
     # reference: joined record ts = max(ts1, ts2) (Stream.hs:298)
     ex = make_join_executor(

@@ -65,11 +65,11 @@ def run_direct(batches):
     return ex, rows
 
 
-def run_pipelined(batches, **kw):
+def run_pipelined(batches, depth=3, workers=1, **kw):
     ex = make_ex()
     for k, v in kw.items():
         setattr(ex, k, v)
-    pipe = IngestPipeline(ex, depth=3)
+    pipe = IngestPipeline(ex, depth=depth, workers=workers)
     rows = []
     for kids, ts, cols in batches:
         rows.extend(pipe.submit(kids, ts, cols))
@@ -84,6 +84,84 @@ def test_pipeline_matches_direct():
     _, piped = run_pipelined(batches)
     assert len(direct) > 0
     assert canon(direct) == canon(piped)
+
+
+def test_pipeline_multiworker_matches_direct_exactly():
+    """Worker POOL (out-of-order encode) + reorder ring: emitted rows
+    must be IDENTICAL to the synchronous path, ordering included."""
+    batches = gen_batches(40)
+    _, direct = run_direct(batches)
+    _, piped = run_pipelined(batches, depth=4, workers=4)
+    assert len(direct) > 0
+    assert direct == piped  # byte-identical rows, order preserved
+
+
+def test_pipeline_multiworker_gap_fallback():
+    batches = gen_batches(24, gap_at=12)
+    _, direct = run_direct(batches)
+    _, piped = run_pipelined(batches, depth=4, workers=3)
+    assert canon(direct) == canon(piped)
+
+
+def make_changes_ex():
+    schema = Schema.of(device=ColumnType.STRING, temp=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("sensors", schema),
+        group_keys=[Col("device")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "cnt"),
+              AggSpec(AggKind.SUM, "total", input=Col("temp"))],
+    )
+    ex = QueryExecutor(node, schema, emit_changes=True, initial_keys=256,
+                       batch_capacity=1024)
+    for k in range(8):
+        ex.key_id_for((f"d{k}",))
+    return ex
+
+
+def test_pipeline_async_change_drain_matches_direct_exactly():
+    """Deferred + ASYNC change drain through a multi-worker pipeline:
+    the full change-row sequence (after the flush barrier) must equal
+    the synchronous inline-decode path exactly — same rows, same
+    order."""
+    batches = gen_batches(30)
+    ex_d = make_changes_ex()
+    direct = []
+    for kids, ts, cols in batches:
+        direct.extend(ex_d.process_columnar(kids, ts, cols))
+
+    ex_p = make_changes_ex()
+    ex_p.defer_change_decode = True
+    ex_p.change_drain_depth = 3
+    ex_p.async_change_drain = True
+    pipe = IngestPipeline(ex_p, depth=4, workers=2)
+    piped = []
+    for kids, ts, cols in batches:
+        piped.extend(pipe.submit(kids, ts, cols))
+    piped.extend(pipe.flush())
+    piped.extend(ex_p.flush_changes())
+    pipe.close()
+    assert not ex_p.has_pending_changes()
+    assert len(direct) > 0
+    assert direct == piped
+
+
+def test_pipeline_stage_stats():
+    batches = gen_batches(10)
+    ex = make_ex()
+    pipe = IngestPipeline(ex, depth=3, workers=2)
+    for kids, ts, cols in batches:
+        pipe.submit(kids, ts, cols)
+    pipe.flush()
+    stats = pipe.stats()
+    pipe.close()
+    for key in ("encode_s", "step_s", "upload_wait_s", "drain_s",
+                "wall_s", "encode_occupancy", "step_occupancy"):
+        assert key in stats
+    assert stats["encode_s"] > 0
+    assert stats["step_s"] > 0
+    assert 0.0 <= stats["encode_occupancy"] <= 1.0
+    pipe.reset_stats()  # must not raise after close
 
 
 def test_pipeline_gap_fallback_matches_direct():

@@ -304,6 +304,96 @@ def test_append_time_replicates():
         server.stop(grace=1)
 
 
+def test_degraded_ack_status_surfaces(tmp_path):
+    """An ack that returned because followers are down/dead must record
+    a degraded durability status — callers can no longer mistake it for
+    full replication (ISSUE 1 satellite)."""
+    dead_port = free_port()
+    leader = ReplicatedStore(open_store("mem://"),
+                             [f"127.0.0.1:{dead_port}"],
+                             replication_factor=2)
+    try:
+        leader.create_log(1)
+        leader.append(1, b"solo")
+        assert leader.last_ack_status == "degraded:followers_down"
+        assert leader.degraded_appends >= 1
+        st = leader.follower_status()
+        assert st[0]["last_ack_status"] == "degraded:followers_down"
+        assert st[0]["behind"] >= 1
+    finally:
+        leader.close()
+
+
+def test_slow_follower_ack_times_out_degraded(monkeypatch):
+    """A follower that is LIVE but never applies (stalled disk, wedged
+    process) must degrade the ack at the timeout, not report success."""
+    from hstream_tpu.store import replica as repl
+
+    monkeypatch.setattr(repl, "_ACK_TIMEOUT_S", 0.4)
+    leader = ReplicatedStore(open_store("mem://"), [],
+                             replication_factor=2)
+
+    class _SlowFollower:
+        addr = "slow:1"
+        alive = True
+        acked_seq = 0
+
+    try:
+        leader.create_log(1)          # before injection: clean ack
+        assert leader.last_ack_status == "replicated"
+        leader._followers = [_SlowFollower()]
+        lsn = leader.append_batch(1, [b"x"])
+        assert lsn == 1               # availability kept...
+        assert leader.last_ack_status == "degraded:timeout"  # ...honestly
+        assert leader.degraded_appends == 1
+    finally:
+        leader._followers = []
+        leader.close()
+
+
+def test_follower_leader_binding_survives_restart():
+    """The accepted leader id persists in store meta: a RESTARTED
+    follower keeps rejecting a stale/second leader instead of accepting
+    whichever connects first (ISSUE 1 satellite)."""
+    follower_store = open_store("mem://")
+    port = free_port()
+    server, _svc = serve_follower(follower_store, f"127.0.0.1:{port}")
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            stub = StoreReplicaStub(ch)
+            e = pb.LogEntry(seq=1, op=pb.OP_CREATE_LOG, logid=3)
+            stub.Replicate(pb.ReplicateRequest(entries=[e],
+                                               leader_id="L1"), timeout=5)
+    finally:
+        server.stop(grace=1)
+    assert follower_store.meta_get("replica/leader_id") == b"L1"
+    # "restart": a fresh service over the same store must reload the
+    # binding and reject a different leader BEFORE applying anything
+    port2 = free_port()
+    server2, svc2 = serve_follower(follower_store, f"127.0.0.1:{port2}")
+    try:
+        assert svc2._leader_id == "L1"
+        with grpc.insecure_channel(f"127.0.0.1:{port2}") as ch:
+            stub = StoreReplicaStub(ch)
+            try:
+                stub.Replicate(pb.ReplicateRequest(
+                    entries=[pb.LogEntry(seq=2, op=pb.OP_CREATE_LOG,
+                                         logid=4)],
+                    leader_id="L2"), timeout=5)
+                raise AssertionError("stale-leader bind accepted")
+            except grpc.RpcError as err:
+                assert err.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert not follower_store.log_exists(4)
+            # the ORIGINAL leader still replicates after the restart
+            stub.Replicate(pb.ReplicateRequest(
+                entries=[pb.LogEntry(seq=2, op=pb.OP_CREATE_LOG,
+                                     logid=5)],
+                leader_id="L1"), timeout=5)
+            assert follower_store.log_exists(5)
+    finally:
+        server2.stop(grace=1)
+
+
 def test_follower_rejects_second_leader():
     follower_store = open_store("mem://")
     port = free_port()

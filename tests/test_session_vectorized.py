@@ -159,3 +159,43 @@ def test_non_numeric_input_skipped_both_paths():
     got = {r["k"]: (r["c"], r["s"]) for r in rows if r["k"] in "ab"}
     assert got["a"] == (3, 3.0), got   # junk counted, not summed
     assert got["b"] == (1, 0.0), got
+
+
+def test_numeric_strings_null_both_paths():
+    """NUMERIC strings ("42") must be NULLed exactly like junk strings
+    on BOTH engines: np.asarray silently coerced an all-numeric-string
+    batch to floats on the vectorized path while the per-record slow
+    path NULLed it — the same record then aggregated differently
+    depending on lateness (ISSUE 1 satellite, session.py NULL rule)."""
+    aggs = [AggSpec(AggKind.SUM, "s", input=Col("v")),
+            AggSpec(AggKind.COUNT, "n", input=Col("v")),
+            AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, gap=1000, grace=0)
+    ex.process([{"k": "a", "v": 1.0}], [BASE + 50_000])  # wm forward
+    # same shape as the junk test: the late rows walk the per-record
+    # fallback, the on-time row the vectorized path — but every string
+    # here PARSES as a number, the case np.asarray used to coerce
+    out = ex.process(
+        [{"k": "a", "v": "7.5"}, {"k": "a", "v": "3"},
+         {"k": "b", "v": "42"}],
+        [BASE + 49_900, BASE + 49_950, BASE + 51_000])
+    assert out == []
+    rows = ex.process([{"k": "z", "v": 0.0}], [BASE + 200_000])
+    got = {r["k"]: (r["c"], r["n"], r["s"])
+           for r in rows if r["k"] in "ab"}
+    assert got["a"] == (3, 1, 1.0), got  # strings counted, never summed
+    assert got["b"] == (1, 0, 0.0), got
+
+
+def test_ragged_sequence_values_nulled_not_crash():
+    """List-valued (ragged) column cells must be NULLed on the
+    vectorized path — np.asarray raises on inhomogeneous shapes and
+    that must not kill the query."""
+    aggs = [AggSpec(AggKind.SUM, "s", input=Col("v")),
+            AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, gap=1000, grace=0)
+    ex.process([{"k": "a", "v": [1.0, 2.0]}, {"k": "a", "v": [3.0]},
+                {"k": "a", "v": 5.0}], [BASE, BASE + 10, BASE + 20])
+    rows = ex.process([{"k": "z", "v": 0.0}], [BASE + 200_000])
+    got = {r["k"]: (r["c"], r["s"]) for r in rows if r["k"] == "a"}
+    assert got["a"] == (3, 5.0), got
