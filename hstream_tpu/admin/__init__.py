@@ -154,6 +154,53 @@ def cmd_assignments(stub, args) -> list[dict]:
     return _admin(stub, "assignments")
 
 
+def cmd_quota(stub, args) -> list[dict]:
+    """Flow-control quota CRUD over the hierarchical quota tree
+    (scopes: cluster | tenant/<ns> | stream/<name>)."""
+    if args.action == "list":
+        return _admin(stub, "quota-list")
+    if args.scope is None:
+        raise SystemExit(f"quota {args.action} needs a scope")
+    if args.action == "get":
+        return _admin(stub, "quota-get", scope=args.scope)
+    if args.action == "unset":
+        return _admin(stub, "quota-unset", scope=args.scope)
+    fields = {}
+    for field, flag in (("records_per_s", args.records),
+                        ("bytes_per_s", args.bytes),
+                        ("read_records_per_s", args.read_records),
+                        ("burst_records", args.burst_records),
+                        ("burst_bytes", args.burst_bytes)):
+        if flag is not None:
+            fields[field] = flag
+    if not fields:
+        raise SystemExit("quota set needs at least one of --records/"
+                         "--bytes/--read-records/--burst-records/"
+                         "--burst-bytes")
+    return _admin(stub, "quota-set", scope=args.scope, **fields)
+
+
+def cmd_flow(stub, args) -> list[dict]:
+    """Live flow-control status: shed level, overload signals, active
+    quotas, per-class shed counters."""
+    out = _admin(stub, "flow-status")[0]
+    rows = [{"": "level", "value": out.get("level"),
+             "detail": f"active={out.get('active')} "
+                       f"credit_window={out.get('credit_window')}"}]
+    for name, sig in sorted(out.get("signals", {}).items()):
+        rows.append({"": f"signal {name}", "value": sig.get("value"),
+                     "detail": f"warn={sig.get('warn')} "
+                               f"crit={sig.get('critical')} "
+                               f"-> {sig.get('level')}"})
+    for cls, n in sorted(out.get("shed", {}).items()):
+        rows.append({"": f"shed {cls}", "value": n, "detail": ""})
+    for scope, q in sorted(out.get("quotas", {}).items()):
+        rows.append({"": f"quota {scope}", "value": "",
+                     "detail": " ".join(f"{k}={v}"
+                                        for k, v in sorted(q.items()))})
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "hstream-tpu-admin",
@@ -186,6 +233,22 @@ def main(argv=None) -> int:
     sub.add_parser("snapshots", help="per-query state snapshot sizes")
     sub.add_parser("replicas", help="store replication follower status")
     sub.add_parser("assignments", help="query -> server scheduler records")
+    p = sub.add_parser("quota",
+                       help="flow-control quotas: get/set/list/unset "
+                            "on cluster | tenant/<ns> | stream/<name>")
+    p.add_argument("action", choices=["get", "set", "list", "unset"])
+    p.add_argument("scope", nargs="?", default=None)
+    p.add_argument("--records", type=float, default=None,
+                   help="append records/s")
+    p.add_argument("--bytes", type=float, default=None,
+                   help="append bytes/s")
+    p.add_argument("--read-records", type=float, default=None,
+                   help="read records/s (Fetch)")
+    p.add_argument("--burst-records", type=float, default=None)
+    p.add_argument("--burst-bytes", type=float, default=None)
+    sub.add_parser("flow",
+                   help="live flow-control status: shed level, "
+                        "overload signals, quotas")
     args = ap.parse_args(argv)
 
     fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]

@@ -17,6 +17,7 @@ from typing import Any, Iterable
 
 import grpc
 
+from hstream_tpu.client.retry import RetryPolicy
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.errors import SQLError
 from hstream_tpu.proto import api_pb2 as pb
@@ -65,13 +66,26 @@ def _show(v: Any) -> str:
 class Client:
     """One connected SQL shell session."""
 
-    def __init__(self, addr: str, out=None):
+    def __init__(self, addr: str, out=None,
+                 retry: RetryPolicy | None = None):
         self.channel = grpc.insecure_channel(addr)
         self.stub = HStreamApiStub(self.channel)
         self.out = out or sys.stdout
+        # RESOURCE_EXHAUSTED (quota/overload shed) retries with jittered
+        # backoff honoring the server's retry-after hint; every other
+        # status surfaces immediately
+        self.retry = retry or RetryPolicy()
 
     def close(self) -> None:
         self.channel.close()
+
+    @property
+    def retries(self) -> int:
+        """Total flow-control retries this session performed."""
+        return self.retry.retries
+
+    def _call(self, method, request):
+        return self.retry.call(method, request)
 
     # ---- statement routing (client.hs:91-132) ---------------------------
 
@@ -85,14 +99,15 @@ class Client:
             if isinstance(plan, plans.SelectPlan) and plan.emit_changes:
                 self._push_query(sql)
             elif isinstance(plan, plans.CreateViewPlan):
-                v = self.stub.CreateView(pb.CreateViewRequest(sql=sql))
+                v = self._call(self.stub.CreateView,
+                               pb.CreateViewRequest(sql=sql))
                 print(f"view {v.view_id} created", file=self.out)
             elif isinstance(plan, plans.CreateSinkConnectorPlan):
-                c = self.stub.CreateSinkConnector(
-                    pb.CreateSinkConnectorRequest(config=sql))
+                c = self._call(self.stub.CreateSinkConnector,
+                               pb.CreateSinkConnectorRequest(config=sql))
                 print(f"connector {c.id} created", file=self.out)
             elif isinstance(plan, plans.CreatePlan):
-                self.stub.CreateStream(pb.Stream(
+                self._call(self.stub.CreateStream, pb.Stream(
                     stream_name=plan.stream, replication_factor=1))
                 print(f"stream {plan.stream} created", file=self.out)
             elif isinstance(plan, plans.TerminatePlan):
@@ -100,12 +115,12 @@ class Client:
                        if plan.query_id is None else
                        pb.TerminateQueriesRequest(
                            query_ids=[plan.query_id]))
-                done = self.stub.TerminateQueries(req)
+                done = self._call(self.stub.TerminateQueries, req)
                 print(f"terminated: {list(done.query_ids)}",
                       file=self.out)
             else:
-                resp = self.stub.ExecuteQuery(
-                    pb.CommandQuery(stmt_text=sql))
+                resp = self._call(self.stub.ExecuteQuery,
+                                  pb.CommandQuery(stmt_text=sql))
                 rows = [rec.struct_to_dict(s) for s in resp.result_set]
                 print(format_table(rows), file=self.out)
         except grpc.RpcError as e:

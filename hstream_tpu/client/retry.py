@@ -1,0 +1,87 @@
+"""Client-side resilience: jittered backoff honoring retry-after.
+
+When the server refuses work with RESOURCE_EXHAUSTED it attaches a
+retry-after hint twice: a `retry-after-ms` trailing-metadata entry and
+a ``retry_after_ms=N`` token in the status message (so even clients
+that drop metadata can parse it). `RetryPolicy.call` retries only that
+status, sleeping
+
+  * ``hint * (1 + U[0, 0.5))`` when the server sent a hint — the hint
+    is a floor, the jitter spreads the herd, or
+  * full-jitter exponential backoff ``U[0, min(max, base * 2^attempt))``
+    when it did not,
+
+for at most `attempts` tries. Sleep/rng are injectable so tier-1 tests
+drive convergence with a fake clock and zero wall-clock sleeps.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import time
+
+import grpc
+
+RETRY_AFTER_KEY = "retry-after-ms"
+_RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
+
+
+def retry_after_ms_from_error(e: grpc.RpcError) -> int | None:
+    """The server's retry-after hint in ms, or None: trailing metadata
+    first, message text as the fallback."""
+    try:
+        md = e.trailing_metadata() or ()
+    except Exception:  # noqa: BLE001 — not all RpcErrors carry it
+        md = ()
+    for k, v in md:
+        if k == RETRY_AFTER_KEY:
+            try:
+                return int(v)
+            except ValueError:
+                break
+    try:
+        details = e.details() or ""
+    except Exception:  # noqa: BLE001
+        details = str(e)
+    m = _RETRY_AFTER_RE.search(details)
+    return int(m.group(1)) if m else None
+
+
+class RetryPolicy:
+    """Bounded retry of RESOURCE_EXHAUSTED with jittered backoff."""
+
+    def __init__(self, attempts: int = 6, base_ms: float = 50.0,
+                 max_ms: float = 5000.0, *, sleep=None, rng=None):
+        self.attempts = max(int(attempts), 1)
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self._sleep = time.sleep if sleep is None else sleep
+        self._rng = random.Random() if rng is None else rng
+        self.retries = 0  # total retries performed over this policy
+
+    def next_delay_ms(self, attempt: int,
+                      hint_ms: int | None = None) -> float:
+        if hint_ms is not None:
+            return hint_ms * (1.0 + 0.5 * self._rng.random())
+        cap = min(self.max_ms, self.base_ms * (1 << attempt))
+        return max(1.0, cap * self._rng.random())
+
+    def call(self, fn, *args, **kwargs):
+        for attempt in range(self.attempts):
+            try:
+                return fn(*args, **kwargs)
+            except grpc.RpcError as e:
+                code = None
+                try:
+                    code = e.code()
+                except Exception:  # noqa: BLE001
+                    pass
+                if (code != grpc.StatusCode.RESOURCE_EXHAUSTED
+                        or attempt == self.attempts - 1):
+                    raise
+                self.retries += 1
+                delay = self.next_delay_ms(
+                    attempt, retry_after_ms_from_error(e))
+                self._sleep(delay / 1000.0)
+        raise AssertionError("unreachable")  # loop always returns/raises

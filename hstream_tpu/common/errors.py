@@ -101,3 +101,20 @@ class ConnectorNotFound(ServerError):
 
 class QueryTerminated(ServerError):
     grpc_status = grpc.StatusCode.ABORTED
+
+
+class ResourceExhausted(ServerError):
+    """Admission refused by flow control (quota or overload shed). The
+    retry-after hint rides both the message text (retry_after_ms=N) and
+    — at the gRPC boundary — a `retry-after-ms` trailing-metadata entry,
+    so any client can back off without a custom status proto."""
+
+    grpc_status = grpc.StatusCode.RESOURCE_EXHAUSTED
+
+    def __init__(self, message: str = "",
+                 retry_after_ms: int | None = None):
+        if retry_after_ms is not None:
+            retry_after_ms = max(1, int(retry_after_ms))
+            message = f"{message} (retry_after_ms={retry_after_ms})"
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms

@@ -168,7 +168,17 @@ class ConnectorTask(threading.Thread):
             reader.start_reading_from_checkpoint(self.logid, LSN_MIN)
             ctx.persistence.set_connector_status(self.connector_id,
                                                  TaskStatus.RUNNING)
+            flow = getattr(ctx, "flow", None)
             while not self._stop_ev.is_set():
+                if flow is not None and flow.active:
+                    # connectors are background work: shed this cycle
+                    # under overload (DEFER and above) and give the
+                    # host back to user traffic
+                    wait = flow.admit_background("connector")
+                    if wait > 0.0:
+                        if self._stop_ev.wait(min(wait, 1.0)):
+                            break
+                        continue
                 results = reader.read(256)
                 if not results:
                     continue

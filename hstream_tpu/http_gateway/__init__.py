@@ -40,6 +40,7 @@ _STATUS = {
     grpc.StatusCode.ALREADY_EXISTS: 409,
     grpc.StatusCode.INVALID_ARGUMENT: 400,
     grpc.StatusCode.FAILED_PRECONDITION: 400,
+    grpc.StatusCode.RESOURCE_EXHAUSTED: 429,
 }
 
 
@@ -162,8 +163,20 @@ class Gateway:
                              for n in out.nodes]
             return 404, {"error": f"no route {method} {path}"}
         except grpc.RpcError as e:
-            return (_STATUS.get(e.code(), 500),
-                    {"error": e.details() or str(e.code())})
+            code = _STATUS.get(e.code(), 500)
+            if code == 429:
+                # flow-control refusal: surface the server's retry-after
+                # hint as the standard header (seconds, rounded up)
+                from hstream_tpu.client.retry import (
+                    retry_after_ms_from_error,
+                )
+
+                ms = retry_after_ms_from_error(e)
+                headers = {"Retry-After":
+                           str(max(1, -(-ms // 1000)) if ms else 1)}
+                return (code, {"error": e.details() or str(e.code()),
+                               "retry_after_ms": ms}, headers)
+            return code, {"error": e.details() or str(e.code())}
         except (TypeError, ValueError, AttributeError, KeyError) as e:
             # malformed request bodies (wrong field types etc.) must get
             # a JSON 400, not a dropped connection + server traceback
@@ -215,15 +228,20 @@ def _make_handler(gw: Gateway):
                     return
             # strip query string, decode %-escapes in resource names
             path = unquote(urlsplit(self.path).path)
-            code, payload = gw.handle(method, path.rstrip("/") or path,
-                                      body)
-            self._send(code, payload)
+            out = gw.handle(method, path.rstrip("/") or path, body)
+            # (code, payload) or (code, payload, extra-headers)
+            code, payload = out[0], out[1]
+            headers = out[2] if len(out) > 2 else None
+            self._send(code, payload, headers)
 
-        def _send(self, code: int, payload: Any) -> None:
+        def _send(self, code: int, payload: Any,
+                  headers: dict[str, str] | None = None) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 

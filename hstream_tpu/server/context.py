@@ -35,7 +35,8 @@ class ServerContext:
                  server_id: int = 1, durable_meta: bool = True,
                  mesh=None,
                  pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
-                 encode_workers: int = DEFAULT_ENCODE_WORKERS):
+                 encode_workers: int = DEFAULT_ENCODE_WORKERS,
+                 credit_window: int | None = None):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
@@ -72,6 +73,16 @@ class ServerContext:
         # one store lose the race visibly instead of corrupting state
         self.config = VersionedConfigStore(store)
         self.boot_epoch = self._bump_boot_epoch()
+        # flow control: admission quotas + overload shedding + delivery
+        # credit windows; quotas persist in the versioned config store
+        # (and therefore replicate/survive restart with it)
+        from hstream_tpu.flow import DEFAULT_CREDIT_WINDOW, FlowGovernor
+
+        self.flow = FlowGovernor(
+            config=self.config, stats=self.stats,
+            credit_window=(DEFAULT_CREDIT_WINDOW if credit_window is None
+                           else credit_window))
+        self.flow.load()
 
     def _bump_boot_epoch(self) -> int:
         from hstream_tpu.store.versioned import VersionMismatch

@@ -58,13 +58,24 @@ VIRTUAL_TABLES = frozenset({
     "__connectors__", "__stats__"})
 
 
+def _abort_hstream(context, e: HStreamError) -> None:
+    """Map a typed error to its gRPC status; flow-control refusals also
+    carry the retry-after hint as trailing metadata so clients can back
+    off without parsing the message text."""
+    ra = getattr(e, "retry_after_ms", None)
+    if ra is not None:
+        context.set_trailing_metadata(
+            (("retry-after-ms", str(int(ra))),))
+    context.abort(e.grpc_status, str(e) or type(e).__name__)
+
+
 def unary(fn):
     @functools.wraps(fn)
     def wrapped(self, request, context):
         try:
             return fn(self, request, context)
         except HStreamError as e:
-            context.abort(e.grpc_status, str(e) or type(e).__name__)
+            _abort_hstream(context, e)
         except grpc.RpcError:
             raise
         except Exception as e:  # noqa: BLE001 — boundary mapping
@@ -81,7 +92,7 @@ def streaming(fn):
         try:
             yield from fn(self, request, context)
         except HStreamError as e:
-            context.abort(e.grpc_status, str(e) or type(e).__name__)
+            _abort_hstream(context, e)
         except grpc.RpcError:
             raise
         except Exception as e:  # noqa: BLE001
@@ -157,6 +168,11 @@ class HStreamApiServicer:
             nbytes += len(data)
         if not payloads:
             raise ServerError("empty append")
+        # flow control: one branch when no quota is set and the overload
+        # detector is quiet (ctx.flow.active is a plain attribute)
+        if ctx.flow.active:
+            ctx.flow.admit_append(request.stream_name, len(payloads),
+                                  nbytes)
         lsn = ctx.store.append_batch(
             logid, payloads,
             getattr(ctx, "append_compression", Compression.NONE))
@@ -359,7 +375,11 @@ class HStreamApiServicer:
             # scheduler seed (SURVEY §2.3 task distribution): only
             # adopt queries whose recorded owner is gone — its boot
             # epoch predates ours; the claim is a CAS, so two racing
-            # successors cannot both take one query
+            # successors cannot both take one query. Adoption is
+            # background work: under overload shedding it defers (the
+            # records stay claimable for a later, healthier boot).
+            if not scheduler.adoption_allowed(ctx, info.query_id):
+                continue
             if not scheduler.try_adopt(ctx, info.query_id):
                 continue
             try:
@@ -416,8 +436,15 @@ class HStreamApiServicer:
     @unary
     def Fetch(self, request, context):
         rt = self.ctx.subscriptions.get(request.subscription_id)
+        flow = self.ctx.flow
+        if flow.active:
+            # read quota: gate the call, charge the actual count after
+            # (debt-based — sustained rate converges on the quota)
+            flow.admit_read(rt.meta.stream_name)
         got = rt.fetch(timeout_ms=int(request.timeout_ms),
                        max_size=int(request.max_size) or 256)
+        if flow.active and got:
+            flow.charge_read(rt.meta.stream_name, len(got))
         out = pb.FetchResponse()
         nbytes = 0
         for rid, payload in got:
@@ -449,14 +476,15 @@ class HStreamApiServicer:
         consumer = rt.register_consumer(first.consumer_name or "consumer")
         if first.ack_ids:
             rt.ack([RecId(a.batch_id, a.batch_index)
-                    for a in first.ack_ids])
+                    for a in first.ack_ids], consumer=consumer)
 
         def drain_acks():
             try:
                 for req in request_iterator:
                     if req.ack_ids:
+                        # acks refill this consumer's delivery credits
                         rt.ack([RecId(a.batch_id, a.batch_index)
-                                for a in req.ack_ids])
+                                for a in req.ack_ids], consumer=consumer)
             except Exception:
                 pass
             finally:
@@ -641,6 +669,30 @@ class HStreamApiServicer:
                    "followers": status() if status else []}
         elif cmd == "assignments":
             out = scheduler.assignments(ctx)
+        elif cmd == "quota-set":
+            from hstream_tpu.flow import Quota
+
+            scope = args.pop("scope")
+            try:
+                q = ctx.flow.set_quota(scope, Quota.from_json(args))
+            except ValueError as e:
+                raise ServerError(str(e)) from e
+            out = {"scope": scope, **q.to_json()}
+        elif cmd == "quota-get":
+            q = ctx.flow.get_quota(args["scope"])
+            out = {"scope": args["scope"],
+                   **({"unset": True} if q is None else q.to_json())}
+        elif cmd == "quota-unset":
+            try:
+                ctx.flow.unset_quota(args["scope"])
+            except ValueError as e:
+                raise ServerError(str(e)) from e
+            out = {"scope": args["scope"], "unset": True}
+        elif cmd == "quota-list":
+            out = {scope: q.to_json()
+                   for scope, q in ctx.flow.list_quotas().items()}
+        elif cmd == "flow-status":
+            out = ctx.flow.status()
         else:
             raise ServerError(f"unknown admin command {cmd!r}")
         return pb.AdminCommandResponse(result=_json.dumps(out))
@@ -708,6 +760,8 @@ class HStreamApiServicer:
             else:
                 record = rec.build_record(plan.raw_payload or b"")
             data = record.SerializeToString()
+            if ctx.flow.active:  # SQL INSERT is an ingress path too
+                ctx.flow.admit_append(plan.stream, 1, len(data))
             lsn = ctx.store.append(logid, data)
             ctx.stats.note_append(plan.stream, 1, len(data))
             return [{"stream": plan.stream, "lsn": lsn}]

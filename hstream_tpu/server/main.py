@@ -45,7 +45,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           replication_factor: int = 2,
           append_compression: str | None = None,
           pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
-          encode_workers: int = DEFAULT_ENCODE_WORKERS
+          encode_workers: int = DEFAULT_ENCODE_WORKERS,
+          credit_window: int | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -65,7 +66,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
     ctx = ServerContext(store, host=host, port=port, mesh=mesh,
                         pipeline_depth=pipeline_depth,
-                        encode_workers=encode_workers)
+                        encode_workers=encode_workers,
+                        credit_window=credit_window)
     if append_compression:
         from hstream_tpu.store.api import Compression
 
@@ -139,6 +141,11 @@ def _parse_args(argv):
                     help="host-encode worker threads per query task "
                          "feeding the staging ring (default "
                          f"{DEFAULT_ENCODE_WORKERS})")
+    ap.add_argument("--credit-window", type=int, default=None,
+                    help="per-consumer in-flight record window for "
+                         "push delivery (StreamingFetch); a stalled "
+                         "consumer holds at most this many undelivered "
+                         "records server-side (default 256)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -147,7 +154,8 @@ def _parse_args(argv):
                 "snapshot_interval_ms": None, "replicate": None,
                 "replication_factor": 2, "append_compression": None,
                 "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
-                "encode_workers": DEFAULT_ENCODE_WORKERS}
+                "encode_workers": DEFAULT_ENCODE_WORKERS,
+                "credit_window": None}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -184,7 +192,8 @@ def main(argv=None) -> None:
         replication_factor=cfg["replication_factor"],
         append_compression=cfg["append_compression"],
         pipeline_depth=cfg["pipeline_depth"],
-        encode_workers=cfg["encode_workers"])
+        encode_workers=cfg["encode_workers"],
+        credit_window=cfg["credit_window"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

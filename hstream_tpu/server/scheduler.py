@@ -73,6 +73,22 @@ def assignment(ctx, query_id: str) -> dict | None:
         return None
 
 
+def adoption_allowed(ctx, query_id: str) -> bool:
+    """Flow-control gate on boot-time adoption: taking over a dead
+    owner's queries is background work, so it sheds at DEFER — before
+    any user append is refused. A skipped query keeps its stale owner
+    record and stays claimable by the next (healthier) boot."""
+    flow = getattr(ctx, "flow", None)
+    if flow is None:
+        return True
+    wait = flow.admit_background("adopt")
+    if wait > 0.0:
+        log.info("deferring adoption of %s under overload "
+                 "(retry in %.1fs)", query_id, wait)
+        return False
+    return True
+
+
 def try_adopt(ctx, query_id: str) -> bool:
     """CAS-claim an unowned or dead-owner query at boot. True = this
     server now owns it and should resume it."""

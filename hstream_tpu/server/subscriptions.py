@@ -24,6 +24,7 @@ from __future__ import annotations
 import bisect
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -169,11 +170,17 @@ class AckWindow:
 
 
 class Consumer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, credit_window: int = 0):
         self.name = name
         self.queue: "queue.Queue[list[tuple[RecId, bytes]]]" = queue.Queue(
             maxsize=64)
         self.alive = True
+        # credit-based delivery: one credit per in-flight record,
+        # refilled by this consumer's acks. None = unbounded (legacy).
+        from hstream_tpu.flow import CreditWindow
+
+        self.credits = (CreditWindow(credit_window)
+                        if credit_window > 0 else None)
 
 
 class SubscriptionRuntime:
@@ -196,6 +203,7 @@ class SubscriptionRuntime:
         # batches reclaimed from dead consumers' queues, redelivered
         # before anything newly fetched (at-least-once while running)
         self._requeue: list[list[tuple[RecId, bytes]]] = []
+        self._last_backlog_feed = 0.0
 
     # ---- reader ------------------------------------------------------------
 
@@ -214,8 +222,14 @@ class SubscriptionRuntime:
                 r = CheckpointedReader(
                     f"subscription-{self.sub_id}",
                     self.ctx.store.new_reader(), self.ctx.ckp_store)
-                r.start_reading_from_checkpoint(self.logid,
-                                                self._start_lsn())
+                start = r.start_reading_from_checkpoint(
+                    self.logid, self._start_lsn())
+                # committed reflects the ACTUAL start position: records
+                # before it are not outstanding, so lag (tail -
+                # committed) is 0 for a fresh LATEST subscriber instead
+                # of the whole log — a benign new subscriber must not
+                # feed a phantom backlog into the overload detector
+                self._committed = max(self._committed, start - 1)
                 self._reader = r
             return self._reader
 
@@ -245,11 +259,24 @@ class SubscriptionRuntime:
             self._maybe_commit()
         return out
 
-    def ack(self, rec_ids: list[RecId]) -> None:
+    def ack(self, rec_ids: list[RecId],
+            consumer: "Consumer | None" = None) -> None:
         with self.lock:
             for rid in rec_ids:
                 self.window.ack(rid)
             self._maybe_commit()
+            targets = ([consumer] if consumer is not None
+                       else list(self.consumers))
+        # refill OUTSIDE the runtime lock: the dispatcher blocks on
+        # credits while holding nothing, and refill only touches the
+        # window's own condition variable. Acks arriving without a
+        # consumer (the unary Acknowledge RPC) cannot be attributed, so
+        # they conservatively refill every registered consumer — the
+        # per-window cap keeps each balance bounded, and a mixed
+        # StreamingFetch-delivery/unary-ack client cannot starve itself
+        for c in targets:
+            if c.credits is not None:
+                c.credits.refill(len(rec_ids))
 
     def _maybe_commit(self) -> None:
         ckp = self.window.advance()
@@ -265,7 +292,8 @@ class SubscriptionRuntime:
     # ---- streaming fetch (consumer round-robin) ----------------------------
 
     def register_consumer(self, name: str) -> Consumer:
-        c = Consumer(name)
+        flow = getattr(self.ctx, "flow", None)
+        c = Consumer(name, getattr(flow, "credit_window", 0) or 0)
         with self.lock:
             self.consumers.append(c)
             if self._dispatcher is None:
@@ -298,15 +326,36 @@ class SubscriptionRuntime:
             except queue.Empty:
                 break
 
+    def _feed_backlog_signal(self) -> None:
+        """~1 Hz: feed this subscription's lag (tail - committed) to the
+        overload detector — the backlog signal of the shed ladder."""
+        flow = getattr(self.ctx, "flow", None)
+        if flow is None or self._reader is None:
+            return  # no reads yet: _committed is not seeded yet
+        now = time.monotonic()
+        if now - self._last_backlog_feed < 1.0:
+            return
+        self._last_backlog_feed = now
+        try:
+            tail = self.ctx.store.tail_lsn(self.logid)
+            flow.overload.note("sub_backlog",
+                               float(max(0, tail - self._committed)),
+                               source=self.sub_id)
+        except Exception:  # noqa: BLE001 — monitoring must not kill
+            pass           # the dispatcher (e.g. stream being deleted)
+
     def _dispatch_loop(self) -> None:
         # 10ms low-res poll like the reference's readAndDispatchRecords
         # timer (Handler.hs:819-922), round-robining batches to consumers.
         # A fetched batch is already noted in the AckWindow, so it must
-        # never be dropped: a batch that finds no queue slot is re-offered
-        # (rotating consumers) until someone takes it — only then do we
-        # fetch more. Otherwise the ack lower bound would stall forever.
+        # never be dropped: a batch that finds no queue slot or no
+        # delivery credit is re-offered (rotating consumers) until
+        # someone takes it — only then do we fetch more. Otherwise the
+        # ack lower bound would stall forever.
         pending: list[tuple[RecId, bytes]] | None = None
+        zero_credit_offers = 0  # consecutive offers refused for credit
         while not self._stop.is_set():
+            self._feed_backlog_signal()
             with self.lock:
                 alive = [c for c in self.consumers if c.alive]
             if not alive:
@@ -328,17 +377,48 @@ class SubscriptionRuntime:
                     continue  # keep pending until a consumer returns
                 c = alive[self._rr % len(alive)]
                 self._rr += 1
+            take = len(pending)
+            if c.credits is not None:
+                # credit-based delivery: at most the consumer's credit
+                # balance goes in flight; zero credit pauses delivery
+                # until its acks refill (slow consumers stop inflating
+                # server memory). Block on the window only when this is
+                # the ONLY consumer — with siblings, rotate immediately
+                # so one stalled consumer cannot throttle the healthy
+                # ones; a short wait after a full zero-credit rotation
+                # keeps the loop from spinning hot
+                block = 0.2 if len(alive) == 1 else 0.0
+                take = c.credits.take_up_to(len(pending), timeout=block)
+                if take == 0:
+                    self._note_credit_wait()
+                    zero_credit_offers += 1
+                    if zero_credit_offers >= len(alive) and block == 0.0:
+                        self._stop.wait(0.01)
+                    continue  # re-offer (rotated) while they drain
+                zero_credit_offers = 0
+            chunk = pending[:take]
             try:
-                c.queue.put(pending, timeout=0.2)
+                c.queue.put(chunk, timeout=0.2)
             except queue.Full:
+                if c.credits is not None:
+                    c.credits.refill(take)
                 continue  # slow consumer: re-offer to the next one
-            pending = None
+            pending = pending[take:] or None
             with self.lock:
                 if not c.alive:
                     # consumer died around the put: unregister's drain may
                     # have run before the put landed — reclaim anything
                     # stranded in the abandoned queue (at-least-once)
                     self._reclaim_locked(c)
+
+    def _note_credit_wait(self) -> None:
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.stream_stat_add("delivery_credit_waits",
+                                      self.meta.stream_name)
+            except Exception:  # noqa: BLE001 — stats must not kill
+                pass           # delivery
 
     def shutdown(self) -> None:
         self._stop.set()

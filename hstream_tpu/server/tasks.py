@@ -119,6 +119,8 @@ class QueryTask(threading.Thread):
         # always-on per-stage timing rings (SURVEY §5.1)
         self.tracer = QueryTracer()
         self._pending_ckps: dict[int, int] = {}  # processed, not committed
+        self._last_flow_feed = 0.0  # overload-signal feed rate limit
+        self._flow_chunks = 0       # warmup chunks skipped (jit compile)
         self._last_snapshot_ms = 0.0
         self._last_persist_ms = 0.0   # cost of the last state write
         self._last_inline_ms = 0.0    # capture-side stall of last snap
@@ -200,8 +202,13 @@ class QueryTask(threading.Thread):
                     self._drain_pipe()
                     self._flush_deferred_changes()
                     self._maybe_snapshot()
+                    # idle = not overloaded: zero samples decay the
+                    # latency EWMA so the shed level recovers
+                    self._feed_flow_signals(0.0)
                     continue
+                t_step = time.perf_counter()
                 self._ingest_results(results)
+                self._feed_flow_signals(time.perf_counter() - t_step)
                 for r in results:
                     lsn = (r.lsn if isinstance(r, DataBatch) else r.hi_lsn)
                     if lsn > self._pending_ckps.get(r.logid, 0):
@@ -267,6 +274,37 @@ class QueryTask(threading.Thread):
             if isinstance(results, BaseException):
                 return
 
+    def _feed_flow_signals(self, step_s: float) -> None:
+        """Feed the overload detector the signals this task produces:
+        per-chunk step latency every chunk (an EWMA update, cheap), and
+        pipeline occupancy + reorder-ring depth at ~1 Hz (stats() walks
+        the stage rings)."""
+        flow = getattr(self.ctx, "flow", None)
+        if flow is None:
+            return
+        if step_s > 0.0 and self._flow_chunks < 5:
+            # warmup: the first real chunks pay jit compile (seconds on
+            # a cold cache) — steady-state overload they are not; idle
+            # zero-samples don't consume the warmup budget
+            self._flow_chunks += 1
+            return
+        det = flow.overload
+        qid = self.info.query_id  # per-source EWMA: tasks don't blend
+        det.note("step_latency_ms", step_s * 1000.0, source=qid)
+        pipe = self._pipe
+        if pipe is None:
+            return
+        now = time.monotonic()
+        if now - self._last_flow_feed < 1.0:
+            return
+        self._last_flow_feed = now
+        st = pipe.stats()
+        det.note("pipeline_occupancy",
+                 max(st.get("encode_occupancy", 0.0),
+                     st.get("step_occupancy", 0.0)), source=qid)
+        det.note("reorder_depth",
+                 pipe.pending / max(self.pipeline_depth, 1), source=qid)
+
     # ---- operator-state checkpointing --------------------------------------
 
     def _restore_state(self) -> dict[int, int] | None:
@@ -325,6 +363,14 @@ class QueryTask(threading.Thread):
         cost = self._last_inline_ms + self._last_persist_ms
         interval = max(self.snapshot_interval_ms, 19.0 * cost)
         if now - self._last_snapshot_ms >= interval:
+            # snapshots are background work: shed them first under
+            # overload — but never past 8x cadence, so replay-on-crash
+            # stays bounded even through a sustained overload episode
+            flow = getattr(self.ctx, "flow", None)
+            if (flow is not None
+                    and now - self._last_snapshot_ms < 8.0 * interval
+                    and flow.admit_background("snapshot") > 0.0):
+                return
             t0 = time.monotonic()
             self._snapshot_now()
             self._last_inline_ms = (time.monotonic() - t0) * 1000

@@ -25,6 +25,9 @@ PER_STREAM_COUNTERS = [
     "append_payload_bytes",    # bytes appended (payload only)
     "append_total",            # append batches
     "append_failed",
+    "append_throttled",        # appends refused by quota (flow control)
+    "shed_total",              # requests refused by overload shedding
+    "delivery_credit_waits",   # push deliveries paused at zero credit
     "record_payload_bytes",    # bytes read out by consumers/queries
     "record_total",            # records read
 ]
@@ -68,31 +71,52 @@ class TimeSeries:
 
 
 class _Shard:
-    __slots__ = ("counters",)
+    __slots__ = ("counters", "owner")
 
-    def __init__(self) -> None:
+    def __init__(self, owner: threading.Thread | None = None) -> None:
         self.counters: dict[tuple[str, str], int] = defaultdict(int)
+        self.owner = owner
 
 
 class StatsHolder:
     """newStatsHolder analogue: per-thread counter shards + shared
-    time-series, aggregated on read (stats.h:80-118)."""
+    time-series, aggregated on read (stats.h:80-118). Shards whose
+    owning thread has exited are folded into a retired aggregate on
+    read, so short-lived threads (per-query tasks, gRPC workers being
+    recycled) cannot grow the shard list forever."""
 
     def __init__(self) -> None:
         self._local = threading.local()
         self._shards: list[_Shard] = []
         self._shards_lock = threading.Lock()
+        self._retired: dict[tuple[str, str], int] = defaultdict(int)
         self._series: dict[tuple[str, str], TimeSeries] = {}
         self._series_lock = threading.Lock()
 
     def _shard(self) -> _Shard:
         sh = getattr(self._local, "shard", None)
         if sh is None:
-            sh = _Shard()
+            sh = _Shard(threading.current_thread())
             self._local.shard = sh
             with self._shards_lock:
                 self._shards.append(sh)
         return sh
+
+    def _fold_dead(self) -> tuple[list[_Shard], dict[tuple[str, str], int]]:
+        """Fold dead threads' shards into the retired aggregate; return
+        (live shards, retired snapshot) captured under one lock so a
+        shard can never be counted both live and retired. A dead thread
+        can no longer write its shard, so the fold loses no increments."""
+        with self._shards_lock:
+            live = []
+            for sh in self._shards:
+                if sh.owner is not None and not sh.owner.is_alive():
+                    for key, v in sh.counters.items():
+                        self._retired[key] += v
+                else:
+                    live.append(sh)
+            self._shards = live
+            return list(live), dict(self._retired)
 
     # ---- counters ----
     def stream_stat_add(self, metric: str, stream: str, value: int = 1
@@ -102,14 +126,17 @@ class StatsHolder:
         self._shard().counters[(metric, stream)] += value
 
     def stream_stat_get(self, metric: str, stream: str) -> int:
-        with self._shards_lock:
-            shards = list(self._shards)
-        return sum(sh.counters.get((metric, stream), 0) for sh in shards)
+        shards, retired = self._fold_dead()
+        total = retired.get((metric, stream), 0)
+        return total + sum(sh.counters.get((metric, stream), 0)
+                           for sh in shards)
 
     def stream_stat_getall(self, metric: str) -> dict[str, int]:
-        with self._shards_lock:
-            shards = list(self._shards)
+        shards, retired = self._fold_dead()
         out: dict[str, int] = defaultdict(int)
+        for (m, stream), v in retired.items():
+            if m == metric:
+                out[stream] += v
         for sh in shards:
             for (m, stream), v in list(sh.counters.items()):
                 if m == metric:

@@ -103,6 +103,31 @@ def test_snapshots_and_replicas_and_assignments(server_stub):
     assert q.id not in admin(stub, "assignments")
 
 
+def test_admin_cli_quota_and_flow_verbs(server_stub, capsys):
+    """The operator CLI's new flow-control verbs end to end:
+    quota set/get/list/unset and the live flow status table."""
+    from hstream_tpu.admin import main as admin_main
+
+    _, ctx = server_stub
+    argv = ["--port", str(ctx.port)]
+    assert admin_main(argv + ["quota", "set", "stream/cliq",
+                              "--records", "7",
+                              "--bytes", "4096"]) == 0
+    out = capsys.readouterr().out
+    assert "stream/cliq" in out and "7" in out
+    assert admin_main(argv + ["quota", "get", "stream/cliq"]) == 0
+    assert "4096" in capsys.readouterr().out
+    assert admin_main(argv + ["quota", "list"]) == 0
+    assert "stream/cliq" in capsys.readouterr().out
+    assert admin_main(argv + ["flow"]) == 0
+    out = capsys.readouterr().out
+    assert "level" in out and "signal" in out and "quota" in out
+    assert admin_main(argv + ["quota", "unset", "stream/cliq"]) == 0
+    capsys.readouterr()
+    assert admin_main(argv + ["quota", "get", "stream/cliq"]) == 0
+    assert "unset" in capsys.readouterr().out
+
+
 def test_virtual_tables(server_stub):
     stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="vt1", replication_factor=2))

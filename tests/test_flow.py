@@ -1,0 +1,520 @@
+"""Flow-control subsystem: hierarchical quotas, overload shedding,
+credit-based delivery, client retry, quota persistence.
+
+Quota/rate tests run on a fake clock — zero wall-clock sleeps; the
+credit-delivery test drives a real dispatcher thread with deadline
+polls (helpers-style), no fixed sleeps on the assert path.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from hstream_tpu.client.retry import RetryPolicy, retry_after_ms_from_error
+from hstream_tpu.common.errors import ResourceExhausted
+from hstream_tpu.flow import (
+    ADMIT,
+    DEFER,
+    REJECT,
+    CreditWindow,
+    FlowGovernor,
+    OverloadDetector,
+    Quota,
+    QuotaTree,
+    TokenBucket,
+    tenant_of,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---- token bucket -----------------------------------------------------------
+
+
+def test_bucket_burst_then_sustained_rate():
+    clk = FakeClock()
+    b = TokenBucket(100.0, 100.0, clock=clk)
+    # the whole burst is admissible immediately...
+    assert b.try_take(100.0) == 0.0
+    # ...then the bucket is empty and reports the accrual wait
+    wait = b.try_take(10.0)
+    assert wait == pytest.approx(0.1)
+    clk.advance(0.5)  # 50 tokens accrue
+    assert b.try_take(50.0) == 0.0
+    assert b.try_take(1.0) > 0.0
+
+
+def test_bucket_debt_converges_on_rate():
+    """Unconditional take (charge-after-read) goes into debt; refills
+    repay it before anything else is admitted."""
+    clk = FakeClock()
+    b = TokenBucket(10.0, 10.0, clock=clk)
+    b.take(30.0)  # 20 tokens of debt
+    assert b.try_take(1.0) > 0.0
+    clk.advance(2.0)  # exactly repays the debt
+    assert b.tokens == pytest.approx(0.0)
+    clk.advance(0.1)
+    assert b.try_take(1.0) == 0.0
+
+
+# ---- quota tree -------------------------------------------------------------
+
+
+def test_tenant_of():
+    assert tenant_of("acme/orders") == "acme"
+    assert tenant_of("acme.events") == "acme"
+    assert tenant_of("acme.a/b") == "acme"
+    assert tenant_of("plain") is None
+
+
+def test_quota_tree_stream_and_tenant_levels():
+    clk = FakeClock()
+    tree = QuotaTree(clk)
+    tree.set("stream/acme.a", Quota(records_per_s=10, burst_records=10))
+    tree.set("tenant/acme", Quota(records_per_s=15, burst_records=15))
+    # stream cap binds first
+    assert tree.admit_append("acme.a", 10, 0) == 0.0
+    assert tree.admit_append("acme.a", 1, 0) > 0.0
+    # the sibling stream has no stream-level quota but shares the tenant
+    # budget, of which acme.a already consumed 10
+    assert tree.admit_append("acme.b", 5, 0) == 0.0
+    assert tree.admit_append("acme.b", 1, 0) > 0.0
+    # an unrelated tenant is untouched
+    assert tree.admit_append("other.x", 100, 0) == 0.0
+
+
+def test_quota_tree_refusal_consumes_nothing():
+    clk = FakeClock()
+    tree = QuotaTree(clk)
+    tree.set("stream/s", Quota(records_per_s=10, burst_records=10,
+                               bytes_per_s=100, burst_bytes=100))
+    assert tree.admit_append("s", 1, 100) == 0.0  # drain bytes bucket
+    # bytes level refuses -> the records bucket must not be charged
+    assert tree.admit_append("s", 1, 50) > 0.0
+    assert tree.admit_append("s", 9, 0) == 0.0  # 9 record tokens intact
+
+
+def test_offered_10x_admitted_at_quota_rate():
+    """Acceptance bar: 10xR offered load admits at R (+/-10%), rejects
+    carry retry-after hints. Fake clock, zero sleeps."""
+    clk = FakeClock()
+    gov = FlowGovernor(clock=clk)
+    R = 100.0
+    gov.quotas.set("stream/s", Quota(records_per_s=R, burst_records=R))
+    gov._recompute_active()
+    assert gov.active
+    admitted = 0
+    hints = []
+    seconds = 20
+    per_tick = 10  # 10ms ticks x 10 records = 1000/s offered = 10xR
+    for _ in range(seconds * 100):
+        clk.advance(0.01)
+        try:
+            gov.admit_append("s", per_tick, 0)
+            admitted += per_tick
+        except ResourceExhausted as e:
+            assert e.retry_after_ms is not None and e.retry_after_ms >= 1
+            hints.append(e.retry_after_ms)
+    expected = R * seconds
+    # +burst_records of slack for the initial full bucket
+    assert 0.9 * expected <= admitted <= 1.1 * expected + R
+    assert hints, "over-quota offered load must produce refusals"
+
+
+def test_quota_rejects_non_positive_rates():
+    with pytest.raises(ValueError):
+        Quota(records_per_s=0)
+    with pytest.raises(ValueError):
+        Quota(bytes_per_s=-5)
+    with pytest.raises(ValueError):
+        Quota.from_json({"records_per_s": 0})
+    with pytest.raises(ValueError):
+        Quota(burst_records=10)  # burst without rate enforces nothing
+    with pytest.raises(ValueError):
+        Quota()  # all-None quota is a no-op, not a limit
+
+
+def test_oversize_batch_admits_into_debt_with_truthful_hint():
+    """A batch larger than the burst admits at a full bucket (going
+    into debt) — the retry-after hint is always achievable, never a
+    forever-retry trap."""
+    clk = FakeClock()
+    gov = FlowGovernor(clock=clk)
+    gov.set_quota("stream/s", Quota(records_per_s=100, burst_records=100))
+    gov.admit_append("s", 150, 0)  # full bucket: admitted, 50 in debt
+    with pytest.raises(ResourceExhausted) as ei:
+        gov.admit_append("s", 150, 0)
+    # waiting out the hint makes the SAME request admissible
+    clk.advance(ei.value.retry_after_ms / 1000.0)
+    gov.admit_append("s", 150, 0)
+    # and the next oversize batch waits again (debt repaid at the rate)
+    wait = gov.quotas.admit_append("s", 150, 0)
+    assert 0 < wait <= 60.0
+
+
+def test_quota_unset_deactivates_hot_path():
+    gov = FlowGovernor(clock=FakeClock())
+    assert not gov.active
+    gov.set_quota("stream/s", Quota(records_per_s=5))
+    assert gov.active
+    gov.unset_quota("stream/s")
+    assert not gov.active
+
+
+# ---- overload detector ------------------------------------------------------
+
+
+def test_overload_detector_transitions_from_pipeline_signals():
+    det = OverloadDetector()
+    assert det.level == ADMIT
+    # synthetic pipeline-stage occupancy ramps: EWMA needs sustained
+    # high samples (one spike is not overload)
+    det.note("pipeline_occupancy", 0.99)
+    assert det.level == ADMIT  # ewma at ~0.5 after one sample
+    for _ in range(6):
+        det.note("pipeline_occupancy", 0.99)
+    assert det.level == REJECT
+    # recovery requires sustained low samples too
+    det.note("pipeline_occupancy", 0.0)
+    assert det.level in (DEFER, REJECT)
+    for _ in range(8):
+        det.note("pipeline_occupancy", 0.0)
+    assert det.level == ADMIT
+
+
+def test_overload_detector_rejects_unknown_signal():
+    with pytest.raises(KeyError):
+        OverloadDetector().note("nope", 1.0)
+
+
+def test_idle_sources_do_not_mask_overloaded_one():
+    """Per-source max aggregation: three idle subscriptions feeding
+    zeros cannot average away one subscription's critical backlog."""
+    det = OverloadDetector()
+    for _ in range(10):
+        det.note("sub_backlog", 150_000.0, source="hot")
+        for idle in ("a", "b", "c"):
+            det.note("sub_backlog", 0.0, source=idle)
+    assert det.effective_level() == REJECT
+
+
+def test_stale_signal_expires_per_signal():
+    """A producer that died at critical (e.g. a deleted subscription's
+    backlog feed) must expire on its own clock — other signals staying
+    fresh and healthy cannot pin the shed level."""
+    clk = FakeClock()
+    det = OverloadDetector(clock=clk, stale_after_s=10.0)
+    for _ in range(10):
+        det.note("sub_backlog", 500_000.0)
+    assert det.effective_level() == REJECT
+    # the backlog feed dies; a healthy query keeps feeding low latency
+    for _ in range(30):
+        clk.advance(1.0)
+        det.note("step_latency_ms", 1.0)
+    assert det.effective_level() == ADMIT  # stale critical expired
+    # and a revived feed counts again
+    for _ in range(10):
+        det.note("sub_backlog", 500_000.0)
+    assert det.effective_level() == REJECT
+
+
+def test_shed_ladder_background_before_user():
+    gov = FlowGovernor(clock=FakeClock())
+    det = gov.overload
+    # DEFER: background sheds, user appends flow
+    for _ in range(8):
+        det.note("step_latency_ms", 400.0)
+    assert det.level == DEFER and gov.active
+    assert gov.admit_background("connector") > 0.0
+    gov.admit_append("s", 1, 10)  # no quota, not rejected at DEFER
+    # REJECT: user appends refused with a retry-after hint
+    for _ in range(8):
+        det.note("step_latency_ms", 10_000.0)
+    assert det.level == REJECT
+    with pytest.raises(ResourceExhausted) as ei:
+        gov.admit_append("s", 1, 10)
+    assert ei.value.retry_after_ms is not None
+    assert gov.admit_background("connector") > 0.0
+    assert gov.shed_by_class["user"] == 1
+    assert gov.shed_by_class["background"] == 2
+
+
+# ---- client retry -----------------------------------------------------------
+
+
+class FakeExhausted(grpc.RpcError):
+    def __init__(self, retry_after_ms=None):
+        self._ra = retry_after_ms
+
+    def code(self):
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+    def details(self):
+        if self._ra is None:
+            return "quota exceeded"
+        return f"quota exceeded (retry_after_ms={self._ra})"
+
+    def trailing_metadata(self):
+        if self._ra is None:
+            return ()
+        return (("retry-after-ms", str(self._ra)),)
+
+
+def test_retry_after_parsing_metadata_and_text():
+    assert retry_after_ms_from_error(FakeExhausted(120)) == 120
+
+    class TextOnly(FakeExhausted):
+        def trailing_metadata(self):
+            return ()
+
+    assert retry_after_ms_from_error(TextOnly(77)) == 77
+    assert retry_after_ms_from_error(FakeExhausted()) is None
+
+
+def test_client_retry_converges_on_quota_without_herd():
+    """N clients against one fake-clock governor: every client's call
+    eventually lands, total admissions track the quota, and the jittered
+    delays are spread (no thundering herd). Zero wall-clock sleeps."""
+    import random
+
+    clk = FakeClock()
+    lock = threading.Lock()  # governor is shared; test is single-threaded
+    gov = FlowGovernor(clock=clk)
+    R = 50.0
+    gov.set_quota("stream/s", Quota(records_per_s=R, burst_records=R))
+
+    def server_append(n):
+        with lock:
+            try:
+                gov.admit_append("s", n, 0)
+            except ResourceExhausted as e:
+                raise FakeExhausted(e.retry_after_ms)
+
+    delays: list[float] = []
+
+    def make_client(seed):
+        def fake_sleep(s):
+            delays.append(s)
+            clk.advance(s)
+
+        return RetryPolicy(attempts=10, sleep=fake_sleep,
+                           rng=random.Random(seed))
+
+    clients = [make_client(i) for i in range(20)]
+    done = 0
+    for round_i in range(5):
+        for c in clients:
+            c.call(server_append, 5)  # raises if it cannot converge
+            done += 1
+    assert done == 100
+    total_retries = sum(c.retries for c in clients)
+    assert total_retries > 0, "10x load must have caused retries"
+    # jitter: the backoff delays must not collapse onto one value
+    assert len({round(d, 6) for d in delays}) > len(delays) // 2
+
+
+# ---- credit-based delivery --------------------------------------------------
+
+
+def test_credit_window_take_refill():
+    w = CreditWindow(8)
+    assert w.take_up_to(5) == 5
+    assert w.take_up_to(5) == 3
+    assert w.take_up_to(1, timeout=0.01) == 0
+    w.refill(4)
+    assert w.take_up_to(100) == 4
+    w.refill(1000)  # capped at the window
+    assert w.available == 8
+
+
+def _poll(predicate, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def test_stalled_subscriber_bounded_by_credit_window():
+    """A consumer that never acks holds at most its credit window of
+    undelivered records server-side; acks resume ordered delivery."""
+    from hstream_tpu.common import records as rec
+    from hstream_tpu.proto import api_pb2 as pb
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+
+    WINDOW = 8
+    N = 50
+    ctx = ServerContext(open_store("mem://"), credit_window=WINDOW)
+    try:
+        ctx.streams.create_stream("credsrc")
+        logid = ctx.streams.get_logid("credsrc")
+        payloads = [rec.build_record({"i": i}).SerializeToString()
+                    for i in range(N)]
+        for p in payloads:  # one record per batch: exact credit math
+            ctx.store.append(logid, p)
+        meta = pb.Subscription(subscription_id="credsub",
+                               stream_name="credsrc")
+        rt = ctx.subscriptions.create(ctx, meta)
+        consumer = rt.register_consumer("slow")
+
+        def queued_records():
+            with consumer.queue.mutex:
+                return sum(len(b) for b in consumer.queue.queue)
+
+        # the dispatcher delivers until credits run out, then pauses
+        assert _poll(lambda: queued_records() == WINDOW)
+        assert not _poll(lambda: queued_records() > WINDOW, timeout=0.5)
+        assert ctx.stats.stream_stat_get(
+            "delivery_credit_waits", "credsrc") > 0
+
+        # drain + ack in order; delivery resumes and stays ordered
+        seen: list[int] = []
+        while len(seen) < N:
+            assert _poll(lambda: not consumer.queue.empty()), \
+                f"stalled after {len(seen)} records"
+            batch = consumer.queue.get_nowait()
+            ids = []
+            for rid, payload in batch:
+                r = rec.parse_record(payload)
+                seen.append(rec.record_to_dict(r)["i"])
+                ids.append(rid)
+            rt.ack(ids, consumer=consumer)
+        assert seen == list(range(N))
+        assert rt.committed_lsn > 0
+    finally:
+        ctx.shutdown()
+
+
+def test_latest_subscriber_reports_zero_backlog():
+    """A fresh LATEST subscriber on a long stream has nothing
+    outstanding — it must not feed the whole log as phantom backlog
+    into the overload detector (which would shed user appends)."""
+    from hstream_tpu.common import records as rec
+    from hstream_tpu.proto import api_pb2 as pb
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+
+    ctx = ServerContext(open_store("mem://"))
+    try:
+        ctx.streams.create_stream("longlog")
+        logid = ctx.streams.get_logid("longlog")
+        for i in range(20):
+            ctx.store.append(
+                logid, rec.build_record({"i": i}).SerializeToString())
+        meta = pb.Subscription(
+            subscription_id="latest1", stream_name="longlog",
+            offset=pb.SubscriptionOffset(special_offset=1))  # LATEST
+        rt = ctx.subscriptions.create(ctx, meta)
+        rt.reader()  # seeds committed from the actual start position
+        tail = ctx.store.tail_lsn(logid)
+        assert rt.committed_lsn >= tail  # lag == 0, not 20
+    finally:
+        ctx.shutdown()
+
+
+def test_unary_acks_refill_streaming_consumer_credits():
+    """Acks arriving without a consumer (the unary Acknowledge RPC)
+    still refill delivery credits — a client mixing StreamingFetch
+    delivery with unary acks must not stall at window exhaustion."""
+    from hstream_tpu.common import records as rec
+    from hstream_tpu.proto import api_pb2 as pb
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+
+    WINDOW = 8
+    N = 3 * WINDOW
+    ctx = ServerContext(open_store("mem://"), credit_window=WINDOW)
+    try:
+        ctx.streams.create_stream("uack")
+        logid = ctx.streams.get_logid("uack")
+        for i in range(N):
+            ctx.store.append(
+                logid, rec.build_record({"i": i}).SerializeToString())
+        rt = ctx.subscriptions.create(
+            ctx, pb.Subscription(subscription_id="uacksub",
+                                 stream_name="uack"))
+        consumer = rt.register_consumer("mixed")
+        seen = 0
+        while seen < N:
+            assert _poll(lambda: not consumer.queue.empty()), \
+                f"stalled after {seen} records (credits not refilled?)"
+            batch = consumer.queue.get_nowait()
+            seen += len(batch)
+            rt.ack([rid for rid, _ in batch])  # unary path: no consumer
+        assert seen == N
+    finally:
+        ctx.shutdown()
+
+
+# ---- persistence ------------------------------------------------------------
+
+
+def test_quota_persists_across_server_restart(tmp_path):
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+
+    path = str(tmp_path / "store")
+    ctx = ServerContext(open_store(path))
+    ctx.flow.set_quota("stream/s",
+                       Quota(records_per_s=5, burst_records=5))
+    ctx.flow.set_quota("tenant/acme", Quota(bytes_per_s=1000))
+    ctx.shutdown()
+
+    ctx2 = ServerContext(open_store(path))
+    try:
+        q = ctx2.flow.get_quota("stream/s")
+        assert q is not None and q.records_per_s == 5.0
+        assert ctx2.flow.get_quota("tenant/acme").bytes_per_s == 1000.0
+        assert ctx2.flow.active
+        # and it is enforced: the 5-record burst admits, the 6th refuses
+        ctx2.flow.admit_append("s", 5, 0)
+        with pytest.raises(ResourceExhausted):
+            ctx2.flow.admit_append("s", 1, 0)
+        # unset survives too
+        ctx2.flow.unset_quota("tenant/acme")
+    finally:
+        ctx2.shutdown()
+
+    ctx3 = ServerContext(open_store(path))
+    try:
+        assert ctx3.flow.get_quota("tenant/acme") is None
+        assert ctx3.flow.get_quota("stream/s") is not None
+    finally:
+        ctx3.shutdown()
+
+
+# ---- stats shard retirement (satellite regression) --------------------------
+
+
+def test_stats_shards_bounded_across_thread_churn():
+    """Counter shards of exited threads fold into a retired aggregate
+    on read: totals exact, shard list bounded."""
+    from hstream_tpu.stats import StatsHolder
+
+    h = StatsHolder()
+    h.stream_stat_add("append_total", "s", 1)  # main-thread shard
+
+    def bump():
+        h.stream_stat_add("append_total", "s", 2)
+
+    for _ in range(40):
+        t = threading.Thread(target=bump)
+        t.start()
+        t.join()
+    assert h.stream_stat_get("append_total", "s") == 1 + 40 * 2
+    assert len(h._shards) <= 2  # main + at most one straggler
+    # getall folds the same way
+    assert h.stream_stat_getall("append_total") == {"s": 81}

@@ -174,8 +174,8 @@ def test_dispatch_reoffers_when_consumer_queue_full(server_stub,
 
     orig_init = subs.Consumer.__init__
 
-    def tiny_init(self, name):
-        orig_init(self, name)
+    def tiny_init(self, name, credit_window=0):
+        orig_init(self, name, credit_window)
         self.queue = queue.Queue(maxsize=1)  # force queue-full quickly
 
     monkeypatch.setattr(subs.Consumer, "__init__", tiny_init)
