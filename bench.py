@@ -546,6 +546,34 @@ def server_path_eps() -> dict:
         drain_to(reqs[-1][0])
         out["server_json_eps"] = round(
             (jb - jwarm) * jn / (time.perf_counter() - t0))
+
+        # exercise the Fetch RPC so the BENCH record carries fetch
+        # percentiles alongside append's (ISSUE 3: host-side breakdown)
+        stub.CreateSubscription(pb.Subscription(
+            subscription_id="bench-sub", stream_name="bsrc"))
+        for _ in range(50):
+            stub.Fetch(pb.FetchRequest(subscription_id="bench-sub",
+                                       timeout_ms=10, max_size=64))
+
+        # RPC latency percentiles from the server's fixed-bucket
+        # histograms + the running task's stage occupancy: the
+        # host-side breakdown, not just ev/s
+        stats = ctx.stats
+
+        def pct(metric: str, q: float):
+            v = stats.histogram_percentile(metric, "", q)
+            return None if v is None else round(v, 3)
+
+        out["rpc_histograms_ms"] = {
+            "append_p50": pct("append_latency_ms", 50),
+            "append_p99": pct("append_latency_ms", 99),
+            "fetch_p50": pct("fetch_latency_ms", 50),
+            "fetch_p99": pct("fetch_latency_ms", 99),
+        }
+        pipe = getattr(task, "_pipe", None)
+        if pipe is not None:
+            out["server_pipeline_stages"] = {
+                k: round(v, 4) for k, v in pipe.stats().items()}
     finally:
         ch.close()
         server.stop(grace=1)

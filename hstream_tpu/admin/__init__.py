@@ -180,6 +180,26 @@ def cmd_quota(stub, args) -> list[dict]:
     return _admin(stub, "quota-set", scope=args.scope, **fields)
 
 
+def cmd_events(stub, args) -> list[dict]:
+    """Operator event journal: shed transitions, degraded appends,
+    adoption/restart/death, snapshot failures."""
+    kwargs = {"limit": args.limit, "since": args.since}
+    if args.kind:
+        kwargs["kind"] = args.kind
+    out = _admin(stub, "events", **kwargs)
+    rows = out[0].get("events", []) if out else []
+    return [{"seq": e.get("seq"), "ts_ms": e.get("ts_ms"),
+             "kind": e.get("kind"), "message": e.get("message")}
+            for e in rows]
+
+
+def cmd_metrics(stub, args) -> list[dict]:
+    """Raw Prometheus exposition (what GET /metrics serves)."""
+    out = _admin(stub, "metrics")
+    print(out[0]["text"], end="")
+    return []
+
+
 def cmd_flow(stub, args) -> list[dict]:
     """Live flow-control status: shed level, overload signals, active
     quotas, per-class shed counters."""
@@ -249,6 +269,18 @@ def main(argv=None) -> int:
     sub.add_parser("flow",
                    help="live flow-control status: shed level, "
                         "overload signals, quotas")
+    p = sub.add_parser("events",
+                       help="operator event journal: shed transitions, "
+                            "degraded appends, adoption, snapshot "
+                            "failures")
+    p.add_argument("--kind", default=None,
+                   help="filter to one event kind")
+    p.add_argument("--since", type=int, default=0,
+                   help="only events with seq > SINCE")
+    p.add_argument("--limit", type=int, default=100)
+    sub.add_parser("metrics",
+                   help="raw Prometheus text exposition "
+                        "(same as gateway GET /metrics)")
     args = ap.parse_args(argv)
 
     fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]

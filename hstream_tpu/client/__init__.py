@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import math
 import sys
+import uuid
 from typing import Any, Iterable
 
 import grpc
 
 from hstream_tpu.client.retry import RetryPolicy
 from hstream_tpu.common import records as rec
+from hstream_tpu.common.logger import REQUEST_ID_KEY
 from hstream_tpu.common.errors import SQLError
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
@@ -75,6 +77,10 @@ class Client:
         # backoff honoring the server's retry-after hint; every other
         # status surfaces immediately
         self.retry = retry or RetryPolicy()
+        # correlation: every statement gets a fresh request id, stamped
+        # into the gRPC metadata; kept here so "what id did my last
+        # statement run under" is answerable (and testable)
+        self.last_request_id: str | None = None
 
     def close(self) -> None:
         self.channel.close()
@@ -84,8 +90,16 @@ class Client:
         """Total flow-control retries this session performed."""
         return self.retry.retries
 
+    def _new_request_id(self) -> str:
+        self.last_request_id = f"cli-{uuid.uuid4().hex[:12]}"
+        return self.last_request_id
+
+    def _metadata(self) -> tuple:
+        return ((REQUEST_ID_KEY, self._new_request_id()),)
+
     def _call(self, method, request):
-        return self.retry.call(method, request)
+        return self.retry.call(method, request,
+                               metadata=self._metadata())
 
     # ---- statement routing (client.hs:91-132) ---------------------------
 
@@ -129,7 +143,8 @@ class Client:
     def _push_query(self, sql: str) -> None:
         """Stream a push query until Ctrl-C (client.hs:117-132)."""
         call = self.stub.ExecutePushQuery(
-            pb.CommandPushQuery(query_text=sql))
+            pb.CommandPushQuery(query_text=sql),
+            metadata=self._metadata())
         print("-- streaming; Ctrl-C to stop --", file=self.out)
         try:
             for s in call:

@@ -23,21 +23,34 @@ from collections import defaultdict, deque
 
 
 class QueryTracer:
-    """Bounded per-stage duration rings for one query."""
+    """Bounded per-stage duration rings for one query.
 
-    def __init__(self, capacity: int = 512):
+    `observer(stage, seconds)` (optional) is invoked on every record —
+    the hook the stats holder's stage-latency histograms ride, so the
+    rings stay self-contained while /metrics sees every span.
+    `request_id` carries the correlation id of the request that created
+    the query (ISSUE 3), surfaced by summary() / admin trace."""
+
+    def __init__(self, capacity: int = 512, *, observer=None):
         self._cap = capacity
         self._rings: dict[str, deque[float]] = defaultdict(
             lambda: deque(maxlen=capacity))
         self._counts: dict[str, int] = defaultdict(int)
         self._totals: dict[str, float] = defaultdict(float)
         self._lock = threading.Lock()
+        self._observer = observer
+        self.request_id: str | None = None
 
     def record(self, stage: str, seconds: float) -> None:
         with self._lock:
             self._rings[stage].append(seconds)
             self._counts[stage] += 1
             self._totals[stage] += seconds
+        if self._observer is not None:
+            try:
+                self._observer(stage, seconds)
+            except Exception:  # noqa: BLE001 — observers are metrics
+                pass           # plumbing; never fail the traced stage
 
     def summary(self) -> dict[str, dict[str, float]]:
         """stage -> {count, total_ms, mean_ms, p50_ms, p95_ms} over the
@@ -59,6 +72,8 @@ class QueryTracer:
                     "p95_ms": round(xs[min(n - 1, (n * 95) // 100)] * 1e3,
                                     3),
                 }
+        if self.request_id:
+            out["request"] = {"id": self.request_id}
         return out
 
 

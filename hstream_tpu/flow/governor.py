@@ -39,20 +39,21 @@ WORK_BACKGROUND = "background"
 
 
 class FlowGovernor:
-    def __init__(self, *, config=None, stats=None, clock=time.monotonic,
+    def __init__(self, *, config=None, stats=None, events=None,
+                 clock=time.monotonic,
                  credit_window: int = DEFAULT_CREDIT_WINDOW,
                  defer_ms: int = 200, reject_ms: int = 1000,
                  signals: dict[str, tuple[float, float]] | None = None):
         self._config = config          # VersionedConfigStore | None
         self._stats = stats            # StatsHolder | None
+        self._events = events          # stats.events.EventJournal | None
         self.clock = clock
         self.credit_window = int(credit_window)
         self.defer_ms = int(defer_ms)
         self.reject_ms = int(reject_ms)
         self.quotas = QuotaTree(clock)
         self.overload = OverloadDetector(
-            signals, clock=clock,
-            on_change=lambda _lvl: self._recompute_active())
+            signals, clock=clock, on_change=self._on_level_change)
         # per-class shed counters (GIL-atomic bumps; flow-status verb).
         # UNIT: denied admission polls, not distinct work items — a
         # deferred connector re-asks every poll cycle, so during a
@@ -67,6 +68,19 @@ class FlowGovernor:
     def _recompute_active(self) -> None:
         self.active = bool(len(self.quotas)) \
             or self.overload.level != ADMIT
+
+    def _on_level_change(self, lvl: int) -> None:
+        self._recompute_active()
+        if self._events is not None:
+            from hstream_tpu.flow.overload import LEVEL_NAMES
+
+            try:
+                self._events.append(
+                    "shed_level",
+                    f"overload ladder -> {LEVEL_NAMES[lvl]}",
+                    level=LEVEL_NAMES[lvl])
+            except Exception:  # noqa: BLE001 — journaling must never
+                pass           # affect admission decisions
 
     # ---- admission: user ingress -------------------------------------------
 

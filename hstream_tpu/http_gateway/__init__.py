@@ -7,8 +7,17 @@ proxying a gRPC client, plus Swagger
 routes the same resources onto the HStreamApi stub; /overview surfaces
 the server's stats holder via the GetStats RPC.
 
-Routes (JSON in/out):
+Every request carries a correlation id: the caller's ``X-Request-Id``
+header when present, a generated one otherwise. The id is stamped into
+the proxied gRPC call's metadata (handlers bind it into their log
+records) and echoed back as a response header, so one id follows a
+request client -> gateway -> handler.
+
+Routes (JSON in/out unless noted):
   GET    /overview                    cluster summary + per-stream stats
+                                      + flow/shed state + pipeline stages
+  GET    /metrics                     Prometheus text exposition
+  GET    /events?kind=&since=&limit=  event journal slice
   GET    /streams                     list
   POST   /streams {"name": ...}       create
   DELETE /streams/<name>              delete
@@ -26,12 +35,18 @@ from __future__ import annotations
 import json
 import re
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 import grpc
 
 from hstream_tpu.common import records as rec
+from hstream_tpu.common.logger import (
+    REQUEST_ID_KEY,
+    current_request_id,
+    request_context,
+)
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 
@@ -44,24 +59,61 @@ _STATUS = {
 }
 
 
+class _CorrelatedStub:
+    """Stub proxy stamping the active request's correlation id into
+    every proxied gRPC call's metadata."""
+
+    def __init__(self, stub: HStreamApiStub):
+        self._stub = stub
+
+    def __getattr__(self, name: str):
+        fn = getattr(self._stub, name)
+
+        def call(request, **kwargs):
+            rid = current_request_id()
+            if rid and "metadata" not in kwargs:
+                kwargs["metadata"] = ((REQUEST_ID_KEY, rid),)
+            return fn(request, **kwargs)
+
+        return call
+
+
 class Gateway:
     """Routes HTTP requests onto a single shared gRPC stub."""
 
     def __init__(self, server_addr: str):
         self.channel = grpc.insecure_channel(server_addr)
-        self.stub = HStreamApiStub(self.channel)
+        self.stub = _CorrelatedStub(HStreamApiStub(self.channel))
 
     def close(self) -> None:
         self.channel.close()
 
     # ---- resource handlers -----------------------------------------------
 
-    def handle(self, method: str, path: str, body: dict | None
-               ) -> tuple[int, Any]:
+    def handle(self, method: str, path: str, body: dict | None,
+               query: str = "") -> tuple[int, Any]:
         stub = self.stub
         try:
             if path == "/overview" and method == "GET":
                 return 200, self._overview()
+            if path == "/metrics" and method == "GET":
+                # Prometheus scrape: raw text passthrough, not JSON
+                from hstream_tpu.stats.prometheus import CONTENT_TYPE
+
+                text = self._admin("metrics")["text"]
+                return 200, text, {"Content-Type": CONTENT_TYPE}
+            if path == "/events" and method == "GET":
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query or "")
+                args: dict[str, Any] = {}
+                if q.get("kind"):
+                    args["kind"] = q["kind"][0]
+                if q.get("since"):
+                    args["since"] = int(q["since"][0])
+                if q.get("limit"):
+                    args["limit"] = int(q["limit"][0])
+                return 200, self._admin("events", **args)["events"]
             if path == "/swagger.json" and method == "GET":
                 return 200, SWAGGER
             if path == "/streams" and method == "GET":
@@ -189,9 +241,17 @@ class Gateway:
                 "created_time_ms": q.created_time_ms,
                 "sql": q.query_text}
 
+    def _admin(self, command: str, **kwargs) -> dict:
+        resp = self.stub.SendAdminCommand(pb.AdminCommandRequest(
+            command=command, args=rec.dict_to_struct(kwargs)))
+        return json.loads(resp.result)
+
     def _overview(self) -> dict:
         """Cluster summary + the stats holder (reference Overview.hs —
-        which never exposed stats; this does, via GetStats)."""
+        which never exposed stats; this does, via GetStats), plus the
+        flow governor's shed/credit state and per-query pipeline stage
+        occupancy — one scrape shows ingest, pipeline, and flow state
+        together (ISSUE 3)."""
         stub = self.stub
         streams = stub.ListStreams(pb.ListStreamsRequest()).streams
         queries = stub.ListQueries(pb.ListQueriesRequest()).queries
@@ -199,6 +259,24 @@ class Gateway:
         conns = stub.ListConnectors(pb.ListConnectorsRequest()).connectors
         nodes = stub.ListNodes(pb.ListNodesRequest()).nodes
         stats = stub.GetStats(pb.GetStatsRequest())
+        try:
+            flow = self._admin("flow-status")
+        except grpc.RpcError:
+            flow = None
+        pipeline: dict[str, Any] = {}
+        qids = [q.id for q in queries] + [f"view-{v.view_id}"
+                                          for v in views]
+        for qid in qids:
+            try:
+                trace = rec.struct_to_dict(stub.GetQueryTrace(
+                    pb.GetQueryRequest(id=qid)))
+            except grpc.RpcError:
+                continue  # not running here
+            stages = trace.get("pipeline")
+            if stages:
+                pipeline[qid] = {k: round(v, 4) if
+                                 isinstance(v, float) else v
+                                 for k, v in stages.items()}
         return {
             "streams": len(streams),
             "queries": len(queries),
@@ -210,6 +288,8 @@ class Gateway:
                 "counters": dict(s.counters),
                 "rates": {k: round(v, 3) for k, v in s.rates.items()},
             } for s in stats.stats],
+            "flow": flow,
+            "pipeline_stages": pipeline,
         }
 
 
@@ -226,9 +306,17 @@ def _make_handler(gw: Gateway):
                 except ValueError:
                     self._send(400, {"error": "invalid JSON body"})
                     return
-            # strip query string, decode %-escapes in resource names
-            path = unquote(urlsplit(self.path).path)
-            out = gw.handle(method, path.rstrip("/") or path, body)
+            # correlation: honor the caller's id, mint one otherwise;
+            # the id rides the proxied gRPC metadata and echoes back
+            rid = (self.headers.get("X-Request-Id")
+                   or f"gw-{uuid.uuid4().hex[:12]}")
+            self._rid = rid
+            # split query string, decode %-escapes in resource names
+            parts = urlsplit(self.path)
+            path = unquote(parts.path)
+            with request_context(rid):
+                out = gw.handle(method, path.rstrip("/") or path, body,
+                                query=parts.query)
             # (code, payload) or (code, payload, extra-headers)
             code, payload = out[0], out[1]
             headers = out[2] if len(out) > 2 else None
@@ -236,11 +324,22 @@ def _make_handler(gw: Gateway):
 
         def _send(self, code: int, payload: Any,
                   headers: dict[str, str] | None = None) -> None:
-            data = json.dumps(payload).encode()
+            headers = dict(headers or {})
+            if isinstance(payload, (str, bytes)):
+                # raw passthrough (/metrics text exposition)
+                data = (payload.encode()
+                        if isinstance(payload, str) else payload)
+                ctype = headers.pop("Content-Type", "text/plain")
+            else:
+                data = json.dumps(payload).encode()
+                ctype = headers.pop("Content-Type", "application/json")
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
-            for k, v in (headers or {}).items():
+            rid = getattr(self, "_rid", None)
+            if rid:
+                self.send_header("X-Request-Id", rid)
+            for k, v in headers.items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
@@ -275,7 +374,12 @@ SWAGGER = {
     "openapi": "3.0.0",
     "info": {"title": "hstream-tpu HTTP gateway", "version": "1.0"},
     "paths": {
-        "/overview": {"get": {"summary": "cluster summary + stats"}},
+        "/overview": {"get": {"summary": "cluster summary + stats + "
+                                         "flow + pipeline stages"}},
+        "/metrics": {"get": {"summary":
+                             "Prometheus text exposition"}},
+        "/events": {"get": {"summary": "event journal slice "
+                                       "(kind/since/limit)"}},
         "/streams": {"get": {"summary": "list streams"},
                      "post": {"summary": "create stream"}},
         "/streams/{name}": {"delete": {"summary": "delete stream"}},

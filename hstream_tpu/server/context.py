@@ -36,7 +36,8 @@ class ServerContext:
                  mesh=None,
                  pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
                  encode_workers: int = DEFAULT_ENCODE_WORKERS,
-                 credit_window: int | None = None):
+                 credit_window: int | None = None,
+                 slow_request_ms: float = 1000.0):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
@@ -64,9 +65,28 @@ class ServerContext:
         self.port = port
         self.server_id = server_id
         from hstream_tpu.stats import StatsHolder
+        from hstream_tpu.stats.events import EventJournal
         from hstream_tpu.store.versioned import VersionedConfigStore
 
         self.stats = StatsHolder()
+        # observability plane: structured event journal + the slow-
+        # request threshold handlers log correlated warnings above
+        self.events = EventJournal()
+        # sampler-style gauge: the holder calls it at scrape time
+        self.stats.gauge_fn("event_journal_size", "",
+                            lambda: len(self.events))
+        self.slow_request_ms = float(slow_request_ms)
+        # a replicated store journals degraded acks / follower loss;
+        # the leadership binding itself is the first journal entry, so
+        # `admin events --kind leader_change` answers "who leads this
+        # store, since when" on the serving node
+        if hasattr(store, "follower_status"):
+            store.journal = self.events
+            self.events.append(
+                "leader_change",
+                f"this server leads the replicated store as "
+                f"{store.node_id}",
+                leader=store.node_id)
         # CAS-versioned cluster config (reference VersionedConfigStore);
         # first consumer: the boot-epoch counter below — each server
         # boot on a store CAS-increments it, so concurrent servers on
@@ -79,7 +99,7 @@ class ServerContext:
         from hstream_tpu.flow import DEFAULT_CREDIT_WINDOW, FlowGovernor
 
         self.flow = FlowGovernor(
-            config=self.config, stats=self.stats,
+            config=self.config, stats=self.stats, events=self.events,
             credit_window=(DEFAULT_CREDIT_WINDOW if credit_window is None
                            else credit_window))
         self.flow.load()
@@ -105,6 +125,13 @@ class ServerContext:
                            "is racing this store")
 
     def shutdown(self) -> None:
+        httpd = getattr(self, "metrics_httpd", None)
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()  # release the listening socket
+            except Exception:
+                pass
         for task in list(self.running_queries.values()):
             try:
                 # detach: snapshot state but leave status RUNNING so the

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Iterable
 
 import grpc
@@ -27,7 +28,12 @@ from hstream_tpu.common.errors import (
     StreamNotFound,
 )
 from hstream_tpu.common.idgen import gen_unique
-from hstream_tpu.common.logger import get_logger
+from hstream_tpu.common.logger import (
+    REQUEST_ID_KEY,
+    current_request_id,
+    get_logger,
+    request_context,
+)
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.server.context import ServerContext
 from hstream_tpu.server import scheduler
@@ -69,19 +75,72 @@ def _abort_hstream(context, e: HStreamError) -> None:
     context.abort(e.grpc_status, str(e) or type(e).__name__)
 
 
+# RPCs measured into fixed-bucket latency histograms (ISSUE 3): the
+# metric names live in the stats registry; the label comes from the
+# request (stream for data-plane RPCs, leading keyword for SQL)
+_RPC_HISTOGRAMS = {
+    "Append": "append_latency_ms",
+    "Fetch": "fetch_latency_ms",
+    "ExecuteQuery": "sql_execute_latency_ms",
+}
+
+
+def _request_id_from(context) -> str:
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == REQUEST_ID_KEY:
+                return str(v)
+    except Exception:  # noqa: BLE001 — metadata is best-effort
+        pass
+    return ""
+
+
+def _rpc_hist_label(rpc: str, request) -> str:
+    if rpc == "ExecuteQuery":
+        txt = (getattr(request, "stmt_text", "") or "").lstrip()
+        return txt.split(None, 1)[0].lower() if txt else ""
+    return (getattr(request, "stream_name", "")
+            or getattr(request, "subscription_id", ""))
+
+
+def _finish_rpc(self, fn_name: str, request, rid: str,
+                t0: float) -> None:
+    """Post-RPC bookkeeping shared by every unary handler: latency
+    histogram + the correlated slow-request log line."""
+    dur_ms = (time.perf_counter() - t0) * 1e3
+    metric = _RPC_HISTOGRAMS.get(fn_name)
+    if metric is not None:
+        try:
+            self.ctx.stats.observe(metric,
+                                   _rpc_hist_label(fn_name, request),
+                                   dur_ms)
+        except Exception:  # noqa: BLE001 — metrics must not fail RPCs
+            pass
+    slow_ms = getattr(self.ctx, "slow_request_ms", None)
+    if slow_ms is not None and dur_ms >= slow_ms:
+        log.warning("slow request: %s took %.1fms (threshold %.0fms)%s",
+                    fn_name, dur_ms, slow_ms,
+                    "" if rid else " [no request id]")
+
+
 def unary(fn):
     @functools.wraps(fn)
     def wrapped(self, request, context):
-        try:
-            return fn(self, request, context)
-        except HStreamError as e:
-            _abort_hstream(context, e)
-        except grpc.RpcError:
-            raise
-        except Exception as e:  # noqa: BLE001 — boundary mapping
-            log.exception("handler %s failed", fn.__name__)
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+        rid = _request_id_from(context)
+        t0 = time.perf_counter()
+        with request_context(rid):
+            try:
+                return fn(self, request, context)
+            except HStreamError as e:
+                _abort_hstream(context, e)
+            except grpc.RpcError:
+                raise
+            except Exception as e:  # noqa: BLE001 — boundary mapping
+                log.exception("handler %s failed", fn.__name__)
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
+            finally:
+                _finish_rpc(self, fn.__name__, request, rid, t0)
 
     return wrapped
 
@@ -89,16 +148,17 @@ def unary(fn):
 def streaming(fn):
     @functools.wraps(fn)
     def wrapped(self, request, context):
-        try:
-            yield from fn(self, request, context)
-        except HStreamError as e:
-            _abort_hstream(context, e)
-        except grpc.RpcError:
-            raise
-        except Exception as e:  # noqa: BLE001
-            log.exception("handler %s failed", fn.__name__)
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+        with request_context(_request_id_from(context)):
+            try:
+                yield from fn(self, request, context)
+            except HStreamError as e:
+                _abort_hstream(context, e)
+            except grpc.RpcError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log.exception("handler %s failed", fn.__name__)
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(e).__name__}: {e}")
 
     return wrapped
 
@@ -173,9 +233,16 @@ class HStreamApiServicer:
         if ctx.flow.active:
             ctx.flow.admit_append(request.stream_name, len(payloads),
                                   nbytes)
-        lsn = ctx.store.append_batch(
-            logid, payloads,
-            getattr(ctx, "append_compression", Compression.NONE))
+        try:
+            lsn = ctx.store.append_batch(
+                logid, payloads,
+                getattr(ctx, "append_compression", Compression.NONE))
+        except Exception:
+            # admitted but not stored (store I/O, replication broken):
+            # the failure counter separates this from quota refusals
+            ctx.stats.stream_stat_add("append_failed",
+                                      request.stream_name)
+            raise
         ctx.stats.note_append(request.stream_name, len(payloads), nbytes)
         out = pb.AppendResponse(stream_name=request.stream_name)
         for i in range(len(payloads)):
@@ -344,6 +411,14 @@ class HStreamApiServicer:
             raise ServerError(f"query {request.id} is already running")
         self._resume_query(info)
         ctx.persistence.set_query_status(info.query_id, TaskStatus.RUNNING)
+        try:
+            ctx.events.append(
+                "query_restarted",
+                f"query {info.query_id} restarted by operator",
+                query=info.query_id,
+                request_id=current_request_id() or None)
+        except Exception:  # noqa: BLE001 — journaling is best-effort
+            pass
         return empty_pb2.Empty()
 
     def _resume_query(self, info: QueryInfo) -> None:
@@ -693,6 +768,17 @@ class HStreamApiServicer:
                    for scope, q in ctx.flow.list_quotas().items()}
         elif cmd == "flow-status":
             out = ctx.flow.status()
+        elif cmd == "events":
+            out = {"events": ctx.events.query(
+                kind=args.get("kind") or None,
+                since=int(args.get("since", 0)),
+                limit=int(args.get("limit", 100)))}
+        elif cmd == "metrics":
+            # full Prometheus exposition as text — the gateway /metrics
+            # route and curl-through-admin both unwrap {"text": ...}
+            from hstream_tpu.stats.prometheus import render_metrics
+
+            out = {"text": render_metrics(ctx)}
         else:
             raise ServerError(f"unknown admin command {cmd!r}")
         return pb.AdminCommandResponse(result=_json.dumps(out))
@@ -762,7 +848,11 @@ class HStreamApiServicer:
             data = record.SerializeToString()
             if ctx.flow.active:  # SQL INSERT is an ingress path too
                 ctx.flow.admit_append(plan.stream, 1, len(data))
-            lsn = ctx.store.append(logid, data)
+            try:
+                lsn = ctx.store.append(logid, data)
+            except Exception:
+                ctx.stats.stream_stat_add("append_failed", plan.stream)
+                raise
             ctx.stats.note_append(plan.stream, 1, len(data))
             return [{"stream": plan.stream, "lsn": lsn}]
         if isinstance(plan, plans.ShowPlan):
@@ -996,6 +1086,9 @@ class HStreamApiServicer:
         scheduler.record_assignment(ctx, query_id)
         task = QueryTask(ctx, info, plan,
                          stream_sink(ctx, sink_stream, sink_type))
+        # correlation: the creating request's id rides the tracer so
+        # `admin trace` ties a running query back to who launched it
+        task.tracer.request_id = current_request_id() or None
         ctx.running_queries[query_id] = task
         task.start()
         return info

@@ -46,7 +46,9 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           append_compression: str | None = None,
           pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
           encode_workers: int = DEFAULT_ENCODE_WORKERS,
-          credit_window: int | None = None
+          credit_window: int | None = None,
+          metrics_port: int | None = None,
+          slow_request_ms: float = 1000.0
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -67,7 +69,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     ctx = ServerContext(store, host=host, port=port, mesh=mesh,
                         pipeline_depth=pipeline_depth,
                         encode_workers=encode_workers,
-                        credit_window=credit_window)
+                        credit_window=credit_window,
+                        slow_request_ms=slow_request_ms)
     if append_compression:
         from hstream_tpu.store.api import Compression
 
@@ -92,6 +95,13 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     # relaunch tasks and re-emit at-least-once rows before dying
     servicer.resume_persisted()
     server.start()
+    if metrics_port is not None:
+        from hstream_tpu.stats.prometheus import serve_exporter
+
+        ctx.metrics_httpd = serve_exporter(ctx, host=host,
+                                           port=metrics_port)
+        log.info("metrics exporter on %s:%d (/metrics, /events)",
+                 host, ctx.metrics_httpd.server_port)
     log.info("hstream-tpu server listening on %s:%d (store %s)",
              host, bound, store_uri)
     return server, ctx
@@ -146,6 +156,13 @@ def _parse_args(argv):
                          "push delivery (StreamingFetch); a stalled "
                          "consumer holds at most this many undelivered "
                          "records server-side (default 256)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics + /events on this "
+                         "port straight off the server process "
+                         "(0 picks a free port); omit to disable")
+    ap.add_argument("--slow-request-ms", type=float, default=None,
+                    help="log a correlated slow-request warning for "
+                         "any RPC slower than this (default 1000)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -155,7 +172,9 @@ def _parse_args(argv):
                 "replication_factor": 2, "append_compression": None,
                 "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
                 "encode_workers": DEFAULT_ENCODE_WORKERS,
-                "credit_window": None}
+                "credit_window": None,
+                "metrics_port": None,
+                "slow_request_ms": 1000.0}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -193,7 +212,9 @@ def main(argv=None) -> None:
         append_compression=cfg["append_compression"],
         pipeline_depth=cfg["pipeline_depth"],
         encode_workers=cfg["encode_workers"],
-        credit_window=cfg["credit_window"])
+        credit_window=cfg["credit_window"],
+        metrics_port=cfg["metrics_port"],
+        slow_request_ms=cfg["slow_request_ms"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -115,9 +115,25 @@ def try_adopt(ctx, query_id: str) -> bool:
         ctx.config.put(_key(query_id), mine, base_version=version)
         log.info("adopted query %s from %s (epoch %s -> %s)", query_id,
                  owner.get("node"), owner.get("epoch"), ctx.boot_epoch)
+        _journal_adoption(ctx, query_id, owner)
         return True
     except VersionMismatch:
         return False  # a racing successor won the claim
+
+
+def _journal_adoption(ctx, query_id: str, owner: dict) -> None:
+    events = getattr(ctx, "events", None)
+    if events is None:
+        return
+    try:
+        events.append(
+            "query_adopted",
+            f"query {query_id} adopted from {owner.get('node')} "
+            f"(epoch {owner.get('epoch')} -> {ctx.boot_epoch})",
+            query=query_id, prev_owner=owner.get("node"),
+            epoch=ctx.boot_epoch)
+    except Exception:  # noqa: BLE001 — journaling must not block boot
+        pass
 
 
 def assignments(ctx) -> dict[str, dict]:

@@ -289,6 +289,15 @@ class SubscriptionRuntime:
     def committed_lsn(self) -> int:
         return self._committed
 
+    def credit_inflight(self) -> int:
+        """Delivery credits currently in flight across this
+        subscription's consumers (observability: the credit_inflight
+        gauge). Unbounded (credits disabled) consumers count 0."""
+        with self.lock:
+            consumers = list(self.consumers)
+        return sum(c.credits.window - c.credits.available
+                   for c in consumers if c.credits is not None)
+
     # ---- streaming fetch (consumer round-robin) ----------------------------
 
     def register_consumer(self, name: str) -> Consumer:

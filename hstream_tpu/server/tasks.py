@@ -116,8 +116,10 @@ class QueryTask(threading.Thread):
         # encode of chunk N+1 overlaps the device work of chunk N
         self._read_q: queue.Queue = queue.Queue(maxsize=PREFETCH_BATCHES)
         self._read_thread: threading.Thread | None = None
-        # always-on per-stage timing rings (SURVEY §5.1)
-        self.tracer = QueryTracer()
+        # always-on per-stage timing rings (SURVEY §5.1); every span
+        # also lands in the holder's stage_latency_ms histogram so
+        # /metrics carries per-stage percentiles across all queries
+        self.tracer = QueryTracer(observer=self._observe_stage)
         self._pending_ckps: dict[int, int] = {}  # processed, not committed
         self._last_flow_feed = 0.0  # overload-signal feed rate limit
         self._flow_chunks = 0       # warmup chunks skipped (jit compile)
@@ -132,6 +134,22 @@ class QueryTask(threading.Thread):
         self._dirty = False
         self._crash = False
         self._detach = False
+
+    def _observe_stage(self, stage: str, seconds: float) -> None:
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.observe("stage_latency_ms", stage, seconds * 1e3)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the ingest loop
+
+    def _journal(self, kind: str, message: str, **fields) -> None:
+        events = getattr(self.ctx, "events", None)
+        if events is not None:
+            try:
+                events.append(kind, message, **fields)
+            except Exception:  # noqa: BLE001
+                pass
 
     def source_streams(self) -> list[str]:
         names = [self.plan.source]
@@ -228,6 +246,11 @@ class QueryTask(threading.Thread):
             self.error = e
             log.error("query %s died: %s\n%s", self.info.query_id, e,
                       traceback.format_exc())
+            self._journal("query_died",
+                          f"query {self.info.query_id} died: "
+                          f"{type(e).__name__}: {e}",
+                          query=self.info.query_id,
+                          error=type(e).__name__)
             try:
                 ctx.persistence.set_query_status(self.info.query_id,
                                                  TaskStatus.CONNECTION_ABORT)
@@ -460,10 +483,16 @@ class QueryTask(threading.Thread):
                 self._persist_busy = True
             try:
                 self._persist_capture(*item)
-            except Exception:  # noqa: BLE001 — a failed write keeps the
-                # previous snapshot; resume replays from it
+            except Exception as e:  # noqa: BLE001 — a failed write keeps
+                # the previous snapshot; resume replays from it
                 log.exception("snapshot persist for %s failed",
                               self.info.query_id)
+                self._journal("snapshot_failed",
+                              f"snapshot persist for "
+                              f"{self.info.query_id} failed: "
+                              f"{type(e).__name__}: {e}",
+                              query=self.info.query_id,
+                              error=type(e).__name__)
             finally:
                 with self._persist_cv:
                     self._persist_busy = False

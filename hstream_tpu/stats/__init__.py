@@ -41,6 +41,48 @@ PER_STREAM_TIME_SERIES = [
 
 _TS_LEVELS = {name: levels for name, levels in PER_STREAM_TIME_SERIES}
 
+# Gauges: point-in-time values sampled from live subsystems. Direct
+# sets (gauge_set) and scrape-time sampling callbacks (gauge_fn) share
+# one registry; the label dimension is the subsystem's natural key
+# (query id, subscription id, follower address, or "" for singletons).
+GAUGES = [
+    "pipeline_occupancy",     # per running query: encode/step busy frac
+    "pipeline_reorder_depth", # per running query: staged-but-unstepped
+    "sub_backlog",            # per subscription: tail - committed LSNs
+    "credit_inflight",        # per subscription: delivery credits out
+    "overload_level",         # shed ladder: 0 admit / 1 defer / 2 reject
+    "replica_ack_lag",        # per follower: oplog entries behind
+    "store_segment_bytes",    # durable store data footprint on disk
+    "store_wal_bytes",        # durable store write-ahead-log footprint
+    "running_queries",        # live query tasks on this server
+    "event_journal_size",     # entries currently held by the journal
+]
+
+# Fixed-bucket latency histograms (Prometheus-style cumulative buckets);
+# upper bounds in milliseconds, +Inf implied. One label per family:
+# `stream` for the RPC families, `stage` for pipeline stage timings.
+LATENCY_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0)
+
+HISTOGRAMS = [
+    # name, bucket upper bounds (ms), label key
+    ("append_latency_ms", LATENCY_BUCKETS_MS, "stream"),
+    ("fetch_latency_ms", LATENCY_BUCKETS_MS, "subscription"),
+    ("sql_execute_latency_ms", LATENCY_BUCKETS_MS, "stmt"),
+    ("stage_latency_ms", LATENCY_BUCKETS_MS, "stage"),
+]
+
+_HIST_BUCKETS = {name: buckets for name, buckets, _label in HISTOGRAMS}
+HIST_LABEL_KEYS = {name: label for name, _b, label in HISTOGRAMS}
+
+# per-metric label-series ceiling: RPC labels come from request fields
+# (a failed Append still observes its latency), so a client looping
+# over random stream names must not grow /metrics without bound —
+# past the cap new labels fold into one overflow series
+HIST_MAX_LABELS = 512
+HIST_OVERFLOW_LABEL = "_overflow"
+
 
 class TimeSeries:
     """Sliding-window rate estimator: ring of 1s buckets, queried over
@@ -57,8 +99,8 @@ class TimeSeries:
             self._buckets[sec] = self._buckets.get(sec, 0.0) + value
             if len(self._buckets) > self._max * 2:
                 cutoff = sec - self._max
-                for k in [k for k in self._buckets if k < cutoff]:
-                    del self._buckets[k]
+                self._buckets = {k: v for k, v in self._buckets.items()
+                                 if k >= cutoff}
 
     def rate(self, window_s: int, now: float | None = None) -> float:
         """Per-second rate over the trailing window."""
@@ -68,6 +110,62 @@ class TimeSeries:
             total = sum(v for s, v in self._buckets.items()
                         if lo < s <= nowi)
         return total / max(window_s, 1)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (Prometheus shape): cumulative
+    bucket counts rendered at exposition time, plus sum and count for
+    the `_sum`/`_count` series. Observe takes the lock — histograms sit
+    on RPC boundaries, not per-record hot loops."""
+
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
+
+    def __init__(self, bounds: tuple[float, ...]):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count)."""
+        with self._lock:
+            counts = list(self.counts)
+            total_sum, total = self.sum, self.count
+        cum = []
+        running = 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        return cum, total_sum, total
+
+    def percentile(self, q: float) -> float | None:
+        """Bucket-interpolated percentile estimate (None while empty).
+        Within a bucket the value is linearly interpolated; the +Inf
+        bucket reports its lower bound (the largest finite edge)."""
+        cum, _s, total = self.snapshot()
+        if total == 0:
+            return None
+        rank = q / 100.0 * total
+        prev_cum = 0
+        for i, c in enumerate(cum):
+            if c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                hi = self.bounds[i]
+                in_bucket = c - prev_cum
+                frac = ((rank - prev_cum) / in_bucket) if in_bucket else 1.0
+                return lo + (hi - lo) * frac
+            prev_cum = c
+        return self.bounds[-1]
 
 
 class _Shard:
@@ -92,6 +190,19 @@ class StatsHolder:
         self._retired: dict[tuple[str, str], int] = defaultdict(int)
         self._series: dict[tuple[str, str], TimeSeries] = {}
         self._series_lock = threading.Lock()
+        # gauges: direct values + scrape-time sampling callbacks; both
+        # keyed (metric, label). A dead callback (its subsystem went
+        # away) is dropped at the next snapshot instead of erroring.
+        self._gauges: dict[tuple[str, str], float] = {}
+        self._gauge_fns: dict[tuple[str, str], object] = {}
+        self._gauge_lock = threading.Lock()
+        # serializes whole scrapes (sample + render): concurrent
+        # scrapers (gateway /metrics, --metrics-port exporter, admin
+        # verb) share the gauge registry, and an unserialized stale-
+        # series sweep could drop a live series a sibling just sampled
+        self.scrape_lock = threading.Lock()
+        self._hists: dict[tuple[str, str], Histogram] = {}
+        self._hist_lock = threading.Lock()
 
     def _shard(self) -> _Shard:
         sh = getattr(self._local, "shard", None)
@@ -164,15 +275,125 @@ class StatsHolder:
         levels = _TS_LEVELS[metric]
         return self._ts(metric, stream).rate(window_s or levels[-1])
 
+    def time_series_streams(self, metric: str) -> list[str]:
+        """Streams with a live series for `metric` (exposition walks
+        this instead of reaching into the series map)."""
+        if metric not in _TS_LEVELS:
+            raise KeyError(f"unregistered time series {metric!r}")
+        with self._series_lock:
+            return sorted({s for (m, s) in self._series if m == metric})
+
     def time_series_peek_rate(self, metric: str, stream: str,
                               window_s: int | None = None) -> float:
-        """Read-only rate: 0.0 when no series exists — monitoring reads
-        must not allocate/retain state on the holder."""
+        """Read-only rate: 0.0 when no series exists for the stream —
+        monitoring reads must not allocate/retain state on the holder.
+        An UNREGISTERED metric raises the same KeyError `_ts` does: a
+        typo'd dashboard query must not read as a silent zero."""
+        if metric not in _TS_LEVELS:
+            raise KeyError(f"unregistered time series {metric!r}")
         with self._series_lock:
             ts = self._series.get((metric, stream))
         if ts is None:
             return 0.0
         return ts.rate(window_s or _TS_LEVELS[metric][-1])
+
+    # ---- gauges ----
+    def gauge_set(self, metric: str, label: str, value: float) -> None:
+        if metric not in GAUGES:
+            raise KeyError(f"unregistered gauge {metric!r}")
+        with self._gauge_lock:
+            self._gauges[(metric, label)] = float(value)
+
+    def gauge_fn(self, metric: str, label: str, fn) -> None:
+        """Register a scrape-time sampler: fn() -> float. Re-registering
+        the same (metric, label) replaces the previous sampler."""
+        if metric not in GAUGES:
+            raise KeyError(f"unregistered gauge {metric!r}")
+        with self._gauge_lock:
+            self._gauge_fns[(metric, label)] = fn
+
+    def gauge_drop(self, metric: str, label: str) -> None:
+        """Remove a gauge value/sampler (its subsystem went away)."""
+        with self._gauge_lock:
+            self._gauges.pop((metric, label), None)
+            self._gauge_fns.pop((metric, label), None)
+
+    def gauge_labels(self, metric: str) -> list[str]:
+        """Labels currently held for one gauge metric (values + fns)."""
+        with self._gauge_lock:
+            return sorted({label for (m, label) in
+                           list(self._gauges) + list(self._gauge_fns)
+                           if m == metric})
+
+    def gauges_snapshot(self) -> dict[tuple[str, str], float]:
+        """All gauges: direct values plus sampled callbacks. A sampler
+        that raises is dropped (its subsystem died between scrapes) —
+        monitoring never propagates subsystem errors."""
+        with self._gauge_lock:
+            out = dict(self._gauges)
+            fns = list(self._gauge_fns.items())
+        dead = []
+        for key, fn in fns:
+            try:
+                out[key] = float(fn())
+            except Exception:  # noqa: BLE001 — scrape must survive
+                dead.append(key)
+        if dead:
+            with self._gauge_lock:
+                for key in dead:
+                    self._gauge_fns.pop(key, None)
+        return out
+
+    # ---- histograms ----
+    def _hist(self, metric: str, label: str) -> Histogram:
+        if metric not in _HIST_BUCKETS:
+            raise KeyError(f"unregistered histogram {metric!r}")
+        key = (metric, label)
+        with self._hist_lock:
+            h = self._hists.get(key)
+            if h is None:
+                n = sum(1 for (m, _l) in self._hists if m == metric)
+                if n >= HIST_MAX_LABELS:
+                    key = (metric, HIST_OVERFLOW_LABEL)
+                    h = self._hists.get(key)
+                    if h is not None:
+                        return h
+                h = Histogram(_HIST_BUCKETS[metric])
+                self._hists[key] = h
+            return h
+
+    def observe(self, metric: str, label: str, value_ms: float) -> None:
+        self._hist(metric, label).observe(value_ms)
+
+    def histograms_snapshot(self) -> dict[tuple[str, str], Histogram]:
+        with self._hist_lock:
+            return dict(self._hists)
+
+    def histogram_percentile(self, metric: str, label: str,
+                             q: float) -> float | None:
+        """Percentile estimate over every series of `metric` when label
+        is ""; otherwise the one labeled series. None while empty."""
+        if metric not in _HIST_BUCKETS:
+            raise KeyError(f"unregistered histogram {metric!r}")
+        with self._hist_lock:
+            if label:
+                hists = [h for k, h in self._hists.items()
+                         if k == (metric, label)]
+            else:
+                hists = [h for (m, _l), h in self._hists.items()
+                         if m == metric]
+        if not hists:
+            return None
+        if len(hists) == 1:
+            return hists[0].percentile(q)
+        merged = Histogram(_HIST_BUCKETS[metric])
+        for h in hists:
+            with h._lock:
+                for i, c in enumerate(h.counts):
+                    merged.counts[i] += c
+                merged.sum += h.sum
+                merged.count += h.count
+        return merged.percentile(q)
 
     # ---- convenience for the append/read hot paths ----
     def note_append(self, stream: str, n_records: int, n_bytes: int) -> None:

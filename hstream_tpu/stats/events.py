@@ -1,0 +1,77 @@
+"""Bounded structured event journal: operator-significant transitions.
+
+Counters and gauges answer "how much"; the journal answers "what
+happened and when" — the discrete transitions an operator greps for
+during an incident: shed-ladder changes, degraded appends, query
+adoption/restart/death, snapshot persist failures. The reference keeps
+these in unstructured logDebug lines; here they are structured entries
+in a fixed-capacity ring, queryable via admin `events` and the
+gateway's ``GET /events``.
+
+Entries are dicts: {seq, ts_ms, kind, message, **fields}. `seq` is a
+process-monotone cursor so a poller can resume with ``since`` instead
+of re-reading the window. The ring drops the oldest entry on overflow —
+appending is O(1) and never blocks the subsystem reporting the event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+# The kind vocabulary (the journal's .inc analogue): append() rejects
+# unregistered kinds so the queryable surface stays enumerable.
+EVENT_KINDS = [
+    "shed_level",        # overload ladder transition (admit/defer/reject)
+    "degraded_append",   # replicated ack fell short of the quorum
+    "follower_down",     # a store follower stopped acking
+    "leader_change",     # a follower accepted a new leader id
+    "query_adopted",     # boot-time takeover of a dead owner's query
+    "query_restarted",   # operator RestartQuery
+    "query_died",        # task hit CONNECTION_ABORT
+    "snapshot_failed",   # background state persist failed
+]
+
+
+class EventJournal:
+    """Fixed-capacity ring of structured events; thread-safe."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque[dict[str, Any]] = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def append(self, kind: str, message: str, **fields: Any) -> int:
+        """Record one event; returns its seq. Fields must be
+        JSON-serializable (they travel through admin/HTTP as JSON)."""
+        if kind not in EVENT_KINDS:
+            raise KeyError(f"unregistered event kind {kind!r}")
+        entry = {"kind": kind, "message": message,
+                 "ts_ms": int(time.time() * 1000), **fields}
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+            return self._seq
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def query(self, *, kind: str | None = None, since: int = 0,
+              limit: int = 100) -> list[dict[str, Any]]:
+        """Newest-last slice of the window: entries with seq > since,
+        optionally one kind, capped at the LAST `limit` matches."""
+        with self._lock:
+            entries = list(self._ring)
+        out = [dict(e) for e in entries
+               if e["seq"] > since and (kind is None or e["kind"] == kind)]
+        return out[-max(int(limit), 1):]

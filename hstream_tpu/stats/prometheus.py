@@ -1,0 +1,317 @@
+"""Prometheus text exposition over the stats holder + live subsystems.
+
+Renders every registered counter, time-series rate, gauge, and
+histogram in the text format scrapers expect (text/plain; version
+0.0.4): `_total` counters, `_bucket`/`_sum`/`_count` histogram series
+with cumulative `le` buckets ending at `+Inf`, label values escaped
+per the spec (backslash, double-quote, newline).
+
+`sample_gauges(ctx)` is the scrape-time bridge from live subsystems —
+pipeline occupancy / reorder depth per running query, subscription
+backlog and delivery credits in flight, the overload ladder state,
+replica ack lag, and the durable store's segment/WAL footprint — into
+the holder's gauge registry; `render_metrics(ctx)` samples and renders
+in one call (the gateway's /metrics, the server's --metrics-port
+exporter, and the admin `metrics` verb all go through it).
+"""
+
+from __future__ import annotations
+
+import os
+
+from hstream_tpu.stats import (
+    GAUGES,
+    HIST_LABEL_KEYS,
+    PER_STREAM_COUNTERS,
+    PER_STREAM_TIME_SERIES,
+)
+
+PREFIX = "hstream"
+
+_HELP = {
+    "append_payload_bytes": "bytes appended (payload only)",
+    "append_total": "append batches accepted",
+    "append_failed": "append batches failed",
+    "append_throttled": "appends refused by quota (flow control)",
+    "shed_total": "requests refused by overload shedding",
+    "delivery_credit_waits": "push deliveries paused at zero credit",
+    "record_payload_bytes": "bytes read out by consumers/queries",
+    "record_total": "records read",
+    "append_in_bytes": "append byte rate over the trailing window",
+    "append_in_records": "append record rate over the trailing window",
+    "record_bytes": "read byte rate over the trailing window",
+    "pipeline_occupancy": "ingest pipeline busy fraction per query",
+    "pipeline_reorder_depth": "staged-but-unstepped batches per query",
+    "sub_backlog": "subscription lag in LSNs (tail - committed)",
+    "credit_inflight": "delivery credits in flight per subscription",
+    "overload_level": "shed ladder: 0 admit / 1 defer / 2 reject",
+    "replica_ack_lag": "op-log entries a follower is behind",
+    "store_segment_bytes": "durable store segment bytes on disk",
+    "store_wal_bytes": "durable store write-ahead-log bytes on disk",
+    "running_queries": "live query tasks on this server",
+    "event_journal_size": "entries held by the event journal",
+    "append_latency_ms": "Append RPC latency",
+    "fetch_latency_ms": "Fetch RPC latency",
+    "sql_execute_latency_ms": "ExecuteQuery RPC latency",
+    "stage_latency_ms": "per-stage query pipeline timings",
+}
+
+
+def escape_label_value(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(value: float) -> str:
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _series(name: str, labels: dict[str, str], value: float) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels.items())
+        return f"{name}{{{inner}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
+def _header(lines: list[str], name: str, mtype: str, help_key: str
+            ) -> None:
+    help_text = _HELP.get(help_key, help_key)
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} {mtype}")
+
+
+def render_holder(stats, *, live_streams=None) -> str:
+    """Exposition text for one StatsHolder: counters (`_total`), rates
+    (gauge), gauges, histograms. `live_streams` (optional set) filters
+    counter/rate series to streams that still exist, like GetStats."""
+    lines: list[str] = []
+    for metric in PER_STREAM_COUNTERS:
+        name = f"{PREFIX}_{metric}" \
+            if metric.endswith("_total") else f"{PREFIX}_{metric}_total"
+        _header(lines, name, "counter", metric)
+        for stream, v in sorted(stats.stream_stat_getall(metric).items()):
+            if live_streams is not None and stream not in live_streams:
+                continue
+            lines.append(_series(name, {"stream": stream}, v))
+    for metric, _levels in PER_STREAM_TIME_SERIES:
+        name = f"{PREFIX}_{metric}_rate"
+        _header(lines, name, "gauge", metric)
+        for stream in stats.time_series_streams(metric):
+            if live_streams is not None and stream not in live_streams:
+                continue
+            lines.append(_series(
+                name, {"stream": stream},
+                stats.time_series_peek_rate(metric, stream)))
+    gauges = stats.gauges_snapshot()
+    for metric in GAUGES:
+        entries = sorted((label, v) for (m, label), v in gauges.items()
+                         if m == metric)
+        if not entries:
+            continue
+        name = f"{PREFIX}_{metric}"
+        _header(lines, name, "gauge", metric)
+        for label, v in entries:
+            labels = {_gauge_label_key(metric): label} if label else {}
+            lines.append(_series(name, labels, v))
+    hists = stats.histograms_snapshot()
+    seen_types: set[str] = set()
+    for (metric, label), h in sorted(hists.items()):
+        name = f"{PREFIX}_{metric}"
+        if metric not in seen_types:
+            _header(lines, name, "histogram", metric)
+            seen_types.add(metric)
+        lkey = HIST_LABEL_KEYS.get(metric, "label")
+        base = {lkey: label} if label else {}
+        cum, total_sum, count = h.snapshot()
+        for bound, c in zip(h.bounds, cum):
+            lines.append(_series(f"{name}_bucket",
+                                 {**base, "le": _fmt(bound)}, c))
+        lines.append(_series(f"{name}_bucket", {**base, "le": "+Inf"},
+                             count))
+        lines.append(_series(f"{name}_sum", base, total_sum))
+        lines.append(_series(f"{name}_count", base, count))
+    return "\n".join(lines) + "\n"
+
+
+def _gauge_label_key(metric: str) -> str:
+    if metric.startswith("pipeline_"):
+        return "query"
+    if metric in ("sub_backlog", "credit_inflight"):
+        return "subscription"
+    if metric == "replica_ack_lag":
+        return "follower"
+    return "label"
+
+
+def _store_dir_bytes(root: str) -> tuple[int, int]:
+    """(segment bytes, wal bytes) under a native store root."""
+    seg = wal = 0
+    try:
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                try:
+                    size = os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    continue
+                if "wal" in f.lower():
+                    wal += size
+                else:
+                    seg += size
+    except OSError:
+        pass
+    return seg, wal
+
+
+def sample_gauges(ctx) -> None:
+    """Sample live subsystems into the holder's gauge registry. Called
+    at scrape time — a scrape's cost is proportional to the number of
+    live queries/subscriptions, never to ingest volume."""
+    stats = ctx.stats
+    # running query tasks: pipeline occupancy + reorder depth
+    tasks = dict(getattr(ctx, "running_queries", {}))
+    stats.gauge_set("running_queries", "", len(tasks))
+    live_q: set[tuple[str, str]] = set()
+    for qid, task in tasks.items():
+        pipe = getattr(task, "_pipe", None)
+        if pipe is None:
+            continue
+        try:
+            st = pipe.stats()
+            occ = max(st.get("encode_occupancy", 0.0),
+                      st.get("step_occupancy", 0.0))
+            stats.gauge_set("pipeline_occupancy", qid, occ)
+            stats.gauge_set("pipeline_reorder_depth", qid, pipe.pending)
+            live_q.add(("pipeline_occupancy", qid))
+            live_q.add(("pipeline_reorder_depth", qid))
+        except Exception:  # noqa: BLE001 — a task tearing down mid-
+            continue       # scrape must not fail the scrape
+    _drop_stale(stats, ("pipeline_occupancy", "pipeline_reorder_depth"),
+                live_q)
+    # subscriptions: backlog + credits in flight
+    live_s: set[tuple[str, str]] = set()
+    for rt in getattr(ctx, "subscriptions").list():
+        try:
+            tail = ctx.store.tail_lsn(rt.logid)
+            stats.gauge_set("sub_backlog", rt.sub_id,
+                            max(0, tail - rt.committed_lsn))
+            stats.gauge_set("credit_inflight", rt.sub_id,
+                            rt.credit_inflight())
+            live_s.add(("sub_backlog", rt.sub_id))
+            live_s.add(("credit_inflight", rt.sub_id))
+        except Exception:  # noqa: BLE001
+            continue
+    _drop_stale(stats, ("sub_backlog", "credit_inflight"), live_s)
+    # flow ladder state
+    flow = getattr(ctx, "flow", None)
+    if flow is not None:
+        stats.gauge_set("overload_level", "",
+                        flow.overload.effective_level())
+    # replica ack lag (leader only)
+    follower_status = getattr(ctx.store, "follower_status", None)
+    live_f: set[tuple[str, str]] = set()
+    if follower_status is not None:
+        try:
+            for f in follower_status():
+                stats.gauge_set("replica_ack_lag", f["addr"],
+                                f["behind"])
+                live_f.add(("replica_ack_lag", f["addr"]))
+        except Exception:  # noqa: BLE001
+            pass
+    _drop_stale(stats, ("replica_ack_lag",), live_f)
+    # durable store footprint (native store roots at a directory)
+    root = getattr(ctx.store, "root", None) \
+        or getattr(getattr(ctx.store, "local", None), "root", None)
+    if root:
+        seg, wal = _store_dir_bytes(str(root))
+        stats.gauge_set("store_segment_bytes", "", seg)
+        stats.gauge_set("store_wal_bytes", "", wal)
+    # event_journal_size is a gauge_fn sampler registered by the
+    # ServerContext — gauges_snapshot() calls it at render time
+
+
+def _drop_stale(stats, metrics: tuple[str, ...],
+                live: set[tuple[str, str]]) -> None:
+    """Drop gauge series whose subsystem (query, subscription,
+    follower) went away, so /metrics reflects the live topology."""
+    for metric in metrics:
+        for label in stats.gauge_labels(metric):
+            if (metric, label) not in live:
+                stats.gauge_drop(metric, label)
+
+
+def render_metrics(ctx) -> str:
+    """One scrape: sample live subsystems, render the full exposition.
+    Whole-scrape serialization (holder.scrape_lock): concurrent
+    scrapers otherwise race sample_gauges' stale-series sweep against
+    each other and intermittently drop live gauges."""
+    with ctx.stats.scrape_lock:
+        sample_gauges(ctx)
+        try:
+            live = set(ctx.streams.find_streams())
+        except Exception:  # noqa: BLE001
+            live = None
+        return render_holder(ctx.stats, live_streams=live)
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def serve_exporter(ctx, host: str = "0.0.0.0", port: int = 9464):
+    """Standalone scrape endpoint on the SERVER process (the
+    `--metrics-port` flag): /metrics (Prometheus text) + /events
+    (journal JSON) straight off the live context — no gRPC hop, so it
+    keeps answering even when the RPC workers are saturated. Returns
+    the httpd; caller owns shutdown. Port 0 picks a free port."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            from urllib.parse import parse_qs, urlsplit
+
+            parts = urlsplit(self.path)
+            if parts.path.rstrip("/") == "/metrics":
+                try:
+                    body = render_metrics(ctx).encode()
+                except Exception as e:  # noqa: BLE001 — scrape boundary
+                    self._send(500, f"# scrape failed: {e}\n".encode())
+                    return
+                self._send(200, body, CONTENT_TYPE)
+            elif parts.path.rstrip("/") == "/events":
+                q = parse_qs(parts.query)
+                try:
+                    events = ctx.events.query(
+                        kind=(q.get("kind") or [None])[0],
+                        since=int((q.get("since") or [0])[0]),
+                        limit=int((q.get("limit") or [100])[0]))
+                except ValueError as e:
+                    self._send(400, f"bad query param: {e}\n".encode())
+                    return
+                self._send(200, json.dumps(events).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"only /metrics and /events live here\n")
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "text/plain") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="metrics-exporter")
+    t.start()
+    return httpd

@@ -119,6 +119,7 @@ class NativeLogStore(LogStore):
 
     def __init__(self, root: str, *, sync_interval_ms: int = 2,
                  segment_bytes: int | None = None):
+        self.root = str(root)  # observability: segment/WAL size gauges
         self._lib = _load()
         err = C.create_string_buffer(256)
         self._h = self._lib.ns_open(str(root).encode(), err)

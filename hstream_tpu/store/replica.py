@@ -170,6 +170,17 @@ class _Follower:
                     log.warning("follower %s down: %s", self.addr,
                                 e.code() if isinstance(e, grpc.RpcError)
                                 else e)
+                    journal = getattr(owner, "journal", None)
+                    if journal is not None:
+                        try:
+                            journal.append(
+                                "follower_down",
+                                f"store follower {self.addr} stopped "
+                                f"acking at seq {self.acked_seq}",
+                                follower=self.addr,
+                                acked_seq=self.acked_seq)
+                        except Exception:  # noqa: BLE001
+                            pass
                 self.alive = False
                 with owner._cond:
                     owner._cond.notify_all()
@@ -264,6 +275,10 @@ class ReplicatedStore(LogStore):
         # replicated)
         self.last_ack_status: str = "replicated"
         self.degraded_appends: int = 0
+        # optional event journal (stats.events.EventJournal): the server
+        # context attaches one so degraded acks / follower loss become
+        # queryable operator events, not just log lines
+        self.journal = None
         self._async_pool = futures.ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="repl-ack")
         self._ops_since_trim = 0
@@ -387,6 +402,14 @@ class ReplicatedStore(LogStore):
             self.last_ack_status = status
             if status != "replicated":
                 self.degraded_appends += 1
+        if status != "replicated" and self.journal is not None:
+            try:
+                self.journal.append(
+                    "degraded_append",
+                    f"append acked {status} at seq {seq}",
+                    status=status, seq=seq)
+            except Exception:  # noqa: BLE001 — journaling must not
+                pass           # affect append durability semantics
         return status
 
     def _wait_acks_inner(self, seq: int) -> str:
@@ -510,9 +533,11 @@ class FollowerService:
     """Follower-side gRPC service: applies in-order entries to the
     local store; always answers with its applied sequence."""
 
-    def __init__(self, local: LogStore, *, node_id: str = "follower"):
+    def __init__(self, local: LogStore, *, node_id: str = "follower",
+                 journal=None):
         self.local = local
         self.node_id = node_id
+        self.journal = journal  # optional stats.events.EventJournal
         self._lock = threading.Lock()
         self._broken: BaseException | None = None
         # the accepted leader binding is DURABLE (store meta): a
@@ -542,6 +567,15 @@ class FollowerService:
                     self._leader_id = request.leader_id
                     self.local.meta_put("replica/leader_id",
                                         request.leader_id.encode())
+                    if self.journal is not None:
+                        try:
+                            self.journal.append(
+                                "leader_change",
+                                f"replica {self.node_id} accepted "
+                                f"leader {request.leader_id}",
+                                leader=request.leader_id)
+                        except Exception:  # noqa: BLE001
+                            pass
                 elif self._leader_id != request.leader_id:
                     # two leaders feeding one follower is operator
                     # error; acking both would silently diverge them
