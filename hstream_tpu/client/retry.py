@@ -3,8 +3,10 @@
 When the server refuses work with RESOURCE_EXHAUSTED it attaches a
 retry-after hint twice: a `retry-after-ms` trailing-metadata entry and
 a ``retry_after_ms=N`` token in the status message (so even clients
-that drop metadata can parse it). `RetryPolicy.call` retries only that
-status, sleeping
+that drop metadata can parse it). `RetryPolicy.call` retries only the
+statuses `RETRYABLE_CODES` classifies as duplication-safe (flow-control
+refusals, issued before any work — every other status, including
+mid-call transport drops, is explicitly NON_RETRYABLE), sleeping
 
   * ``hint * (1 + U[0, 0.5))`` when the server sent a hint — the hint
     is a floor, the jitter spreads the herd, or
@@ -25,6 +27,46 @@ import grpc
 
 RETRY_AFTER_KEY = "retry-after-ms"
 _RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
+
+# Retryability classification of every status the server emits (the
+# analyzer's errcontract pass keeps this table honest in both
+# directions: emitted ⊆ classified, retried ⊆ emitted ∪ transport).
+#
+# Retryable: the refusal is issued BEFORE any work happens, so
+# re-sending the identical request is duplication-safe.
+#   RESOURCE_EXHAUSTED  flow-control refusal (quota / overload shed);
+#                       the server attaches a retry-after hint
+# Non-retryable: re-sending cannot help, or could double-apply.
+#   NOT_FOUND / ALREADY_EXISTS / INVALID_ARGUMENT — caller errors
+#   FAILED_PRECONDITION — state conflict (e.g. a replica already bound
+#                       to another leader); needs operator action
+#   INTERNAL            server-side failure; retrying re-runs the
+#                       failure and can duplicate side effects
+#   ABORTED             the operation was terminated on purpose
+#   UNAVAILABLE         transport drop — possibly MID-CALL, after a
+#                       mutation landed but before its response; the
+#                       server has no request-id dedup, so a blind
+#                       resend can append the same records twice.
+#                       Blanket retry is unsafe at this layer; an
+#                       application that knows its call is idempotent
+#                       retries it itself.
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+NON_RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.NOT_FOUND,
+    grpc.StatusCode.ALREADY_EXISTS,
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.FAILED_PRECONDITION,
+    grpc.StatusCode.INTERNAL,
+    grpc.StatusCode.ABORTED,
+    grpc.StatusCode.UNAVAILABLE,
+})
+
+
+def is_retryable(code) -> bool:
+    """Classify a grpc.StatusCode; unknown codes are non-retryable."""
+    return code in RETRYABLE_CODES
 
 
 def retry_after_ms_from_error(e: grpc.RpcError) -> int | None:
@@ -49,7 +91,7 @@ def retry_after_ms_from_error(e: grpc.RpcError) -> int | None:
 
 
 class RetryPolicy:
-    """Bounded retry of RESOURCE_EXHAUSTED with jittered backoff."""
+    """Bounded retry of retryable statuses with jittered backoff."""
 
     def __init__(self, attempts: int = 6, base_ms: float = 50.0,
                  max_ms: float = 5000.0, *, sleep=None, rng=None):
@@ -77,8 +119,7 @@ class RetryPolicy:
                     code = e.code()
                 except Exception:  # noqa: BLE001
                     pass
-                if (code != grpc.StatusCode.RESOURCE_EXHAUSTED
-                        or attempt == self.attempts - 1):
+                if not is_retryable(code) or attempt == self.attempts - 1:
                     raise
                 self.retries += 1
                 delay = self.next_delay_ms(

@@ -22,7 +22,12 @@ class CreditWindow:
 
     @property
     def available(self) -> int:
-        return self._avail
+        # found by hstream-analyze (lock-guard): _avail is mutated
+        # under _cv by take_up_to (dispatcher) and refill (ack
+        # threads); the unlocked read fed torn in-flight values to the
+        # credit_inflight gauge
+        with self._cv:
+            return self._avail
 
     def take_up_to(self, n: int, timeout: float = 0.0) -> int:
         """Take up to `n` credits; blocks up to `timeout` for the first

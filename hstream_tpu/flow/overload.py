@@ -143,7 +143,10 @@ class OverloadDetector:
         disagrees writes the level back (and re-fires on_change), so
         the hot-path gate recovers even when no producer ever feeds
         another sample."""
-        if self.level == ADMIT:
+        # deliberate lock-free fast path: the quiet-system gate must
+        # cost one attribute read; a stale ADMIT is corrected by the
+        # next note(), any non-ADMIT read falls into the locked path
+        if self.level == ADMIT:  # analyze: ok lock-guard
             return ADMIT
         now = self._clock()
         cb = None

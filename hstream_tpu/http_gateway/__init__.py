@@ -50,12 +50,18 @@ from hstream_tpu.common.logger import (
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import HStreamApiStub
 
+# Every status the server emits maps EXPLICITLY (the analyzer's
+# errcontract pass enforces it): 500-by-default would let a new status
+# silently degrade to an opaque 500 instead of failing the contract.
 _STATUS = {
     grpc.StatusCode.NOT_FOUND: 404,
     grpc.StatusCode.ALREADY_EXISTS: 409,
     grpc.StatusCode.INVALID_ARGUMENT: 400,
     grpc.StatusCode.FAILED_PRECONDITION: 400,
     grpc.StatusCode.RESOURCE_EXHAUSTED: 429,
+    grpc.StatusCode.ABORTED: 409,
+    grpc.StatusCode.INTERNAL: 500,
+    grpc.StatusCode.UNAVAILABLE: 503,
 }
 
 

@@ -279,6 +279,8 @@ class SubscriptionRuntime:
                 c.credits.refill(len(rec_ids))
 
     def _maybe_commit(self) -> None:
+        """Caller holds self.lock (fetch/ack call this inside their
+        critical section)."""
         ckp = self.window.advance()
         if ckp is not None and ckp > self._committed:
             self._committed = ckp
@@ -287,7 +289,11 @@ class SubscriptionRuntime:
 
     @property
     def committed_lsn(self) -> int:
-        return self._committed
+        # found by hstream-analyze (lock-guard): _committed is written
+        # under self.lock by fetch/ack; an unlocked read here could
+        # surface a torn/stale lag to sub-lag admin + the backlog gauge
+        with self.lock:
+            return self._committed
 
     def credit_inflight(self) -> int:
         """Delivery credits currently in flight across this
@@ -339,16 +345,20 @@ class SubscriptionRuntime:
         """~1 Hz: feed this subscription's lag (tail - committed) to the
         overload detector — the backlog signal of the shed ladder."""
         flow = getattr(self.ctx, "flow", None)
-        if flow is None or self._reader is None:
-            return  # no reads yet: _committed is not seeded yet
+        if flow is None:
+            return
         now = time.monotonic()
         if now - self._last_backlog_feed < 1.0:
             return
+        with self.lock:
+            if self._reader is None:
+                return  # no reads yet: _committed is not seeded yet
+            committed = self._committed
         self._last_backlog_feed = now
         try:
             tail = self.ctx.store.tail_lsn(self.logid)
             flow.overload.note("sub_backlog",
-                               float(max(0, tail - self._committed)),
+                               float(max(0, tail - committed)),
                                source=self.sub_id)
         except Exception:  # noqa: BLE001 — monitoring must not kill
             pass           # the dispatcher (e.g. stream being deleted)
@@ -435,6 +445,16 @@ class SubscriptionRuntime:
             for c in self.consumers:
                 c.alive = False
             self.consumers.clear()
+            dispatcher = self._dispatcher
+        # found by hstream-analyze (resource-leak): the dispatcher was
+        # signalled but never reaped, so DeleteSubscription could return
+        # while the loop was still mid-fetch — racing the checkpoint
+        # remove and re-committing into a deleted subscription's store
+        # state. Join OUTSIDE the lock (the loop takes self.lock per
+        # tick); its waits are all bounded, so 5s covers a full tick.
+        if dispatcher is not None \
+                and dispatcher is not threading.current_thread():
+            dispatcher.join(timeout=5)
 
 
 class SubscriptionRegistry:

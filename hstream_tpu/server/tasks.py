@@ -272,8 +272,10 @@ class QueryTask(threading.Thread):
                 # teardown: a daemon thread caught mid device fetch
                 # during runtime destruction aborts the process
                 t.join(timeout=10)
-            if self._pipe is not None:
-                self._pipe.close()
+            with self.state_lock:
+                pipe = self._pipe
+            if pipe is not None:
+                pipe.close()
             ctx.running_queries.pop(self.info.query_id, None)
 
     def _read_loop(self, reader: CheckpointedReader) -> None:
@@ -314,7 +316,8 @@ class QueryTask(threading.Thread):
         det = flow.overload
         qid = self.info.query_id  # per-source EWMA: tasks don't blend
         det.note("step_latency_ms", step_s * 1000.0, source=qid)
-        pipe = self._pipe
+        with self.state_lock:  # _pipe is guarded (hstream-analyze)
+            pipe = self._pipe
         if pipe is None:
             return
         now = time.monotonic()
@@ -359,7 +362,8 @@ class QueryTask(threading.Thread):
         """Drain deferred changelog extracts (queued, async-drain, or
         join-coalesced) to the sink — idle ticks and pre-snapshot; the
         snapshot guard requires an empty queue."""
-        ex = self.executor
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
         if ex is None:
             return
         hp = getattr(ex, "has_pending_changes", None)
@@ -423,7 +427,9 @@ class QueryTask(threading.Thread):
             return
         extra: dict[str, Any] = {
             "ckps": {str(k): v for k, v in self._pending_ckps.items()}}
-        if self.executor is None:
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            executor = self.executor
+        if executor is None:
             # nothing aggregated yet (e.g. raw records only): committing
             # the read position loses no state
             if self._reader is not None and self._pending_ckps:
@@ -785,10 +791,12 @@ class QueryTask(threading.Thread):
 
     def _drain_pipe(self) -> None:
         """Pipeline barrier: every submitted batch processed, rows sunk."""
-        if self._pipe is None or self._pipe.pending == 0:
+        with self.state_lock:  # _pipe is guarded (hstream-analyze)
+            pipe = self._pipe
+        if pipe is None or pipe.pending == 0:
             return
         with self.state_lock:
-            rows = self._pipe.flush()
+            rows = pipe.flush()
             if rows:
                 with trace_span(self.tracer, "emit"):
                     self.sink(rows)

@@ -18,6 +18,8 @@ exporter, and the admin `metrics` verb all go through it).
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 from hstream_tpu.stats import (
     GAUGES,
@@ -149,13 +151,33 @@ def _gauge_label_key(metric: str) -> str:
     return "label"
 
 
+# TTL cache for the store-footprint walk: found by hstream-analyze
+# (blocking-hot) — the walk ran on EVERY scrape, so a store with many
+# segment files turned each /metrics hit into an unbounded stat storm.
+# One walk per root per TTL bounds the scrape path; footprint moves
+# slowly, 5s staleness is fine. Concurrent scrapers cannot race a cold
+# walk: render_metrics serializes whole scrapes under the holder's
+# scrape_lock, so at most one walk runs per expiry.
+_DIR_BYTES_TTL_S = 5.0
+_dir_bytes_cache: dict[str, tuple[float, tuple[int, int]]] = {}
+_dir_bytes_lock = threading.Lock()
+
+
 def _store_dir_bytes(root: str) -> tuple[int, int]:
-    """(segment bytes, wal bytes) under a native store root."""
+    """(segment bytes, wal bytes) under a native store root; cached
+    for _DIR_BYTES_TTL_S so scrape cost stays O(live subsystems)."""
+    now = time.monotonic()
+    with _dir_bytes_lock:
+        hit = _dir_bytes_cache.get(root)
+        if hit is not None and now - hit[0] < _DIR_BYTES_TTL_S:
+            return hit[1]
     seg = wal = 0
     try:
+        # analyze: ok blocking-hot — deliberate: one cold walk per TTL
         for dirpath, _dirs, files in os.walk(root):
             for f in files:
                 try:
+                    # analyze: ok blocking-hot — bounded by the TTL cache
                     size = os.path.getsize(os.path.join(dirpath, f))
                 except OSError:
                     continue
@@ -165,6 +187,9 @@ def _store_dir_bytes(root: str) -> tuple[int, int]:
                     seg += size
     except OSError:
         pass
+    with _dir_bytes_lock:
+        # stamp AFTER the walk so a slow walk doesn't eat into the TTL
+        _dir_bytes_cache[root] = (time.monotonic(), (seg, wal))
     return seg, wal
 
 

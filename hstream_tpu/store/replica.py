@@ -334,16 +334,21 @@ class ReplicatedStore(LogStore):
         """Per-follower liveness/lag plus the store-level ack status on
         every entry, so one call answers both "who is behind" and "was
         the last ack degraded"."""
+        # found by hstream-analyze (lock-guard): _seq is written under
+        # _cond by _log_and_apply/meta_cas on appender threads; reading
+        # it unlocked here could report a lag computed from a stale seq
+        seq = self.oplog_seq
         return [{"addr": f.addr, "alive": f.alive,
                  "acked_seq": f.acked_seq,
-                 "behind": max(0, self._seq - f.acked_seq),
+                 "behind": max(0, seq - f.acked_seq),
                  "last_ack_status": self.last_ack_status,
                  "degraded_appends": self.degraded_appends}
                 for f in self._followers]
 
     @property
     def oplog_seq(self) -> int:
-        return self._seq
+        with self._cond:
+            return self._seq
 
     # ---- LogStore: mutations (replicated) ----------------------------------
 

@@ -410,3 +410,174 @@ def test_empty_columnar_record_delivered_verbatim(server_stub):
         ack_ids=[rr.record_id for rr in got.received_records]))
     rt = ctx.subscriptions.get("sub-edgy")
     assert rt.committed_lsn > 0  # ack window advanced
+
+
+# ---- ISSUE 4: defects found by hstream-analyze ------------------------------
+
+
+class _TrackingLock:
+    """Duck-typed lock/condition wrapper counting acquisitions, so a
+    test can pin 'this read holds the lock' without relying on a race
+    the GIL usually masks."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.entered = 0
+
+    def __enter__(self):
+        self.entered += 1
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_subscription_shutdown_joins_dispatcher(server_stub):
+    """resource-leak fix: remove() must reap the dispatcher thread —
+    pre-fix it was only signalled, so DeleteSubscription could return
+    while the loop was still mid-fetch against deleted state."""
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="reaped"))
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="reap1", stream_name="reaped",
+        offset=pb.SubscriptionOffset(special_offset=0)))
+    rt = ctx.subscriptions.get("reap1")
+    rt.register_consumer("c0")
+    assert _wait(lambda: rt._dispatcher is not None
+                 and rt._dispatcher.is_alive())
+    dispatcher = rt._dispatcher
+    ctx.subscriptions.remove("reap1")  # -> rt.shutdown()
+    assert not dispatcher.is_alive(), \
+        "shutdown() returned with the dispatcher still running"
+
+
+def test_subscription_committed_lsn_reads_under_lock(server_stub):
+    """lock-guard fix: committed_lsn is written under rt.lock by the
+    fetch/ack paths; the observability read must hold it too."""
+    stub, ctx = server_stub
+    stub.CreateStream(pb.Stream(stream_name="lockedsub"))
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="ls1", stream_name="lockedsub",
+        offset=pb.SubscriptionOffset(special_offset=0)))
+    rt = ctx.subscriptions.get("ls1")
+    rt.lock = _TrackingLock(rt.lock)
+    before = rt.lock.entered
+    assert rt.committed_lsn == 0
+    assert rt.lock.entered == before + 1
+
+
+def test_replica_oplog_seq_reads_under_cond():
+    """lock-guard fix: ReplicatedStore._seq is written under _cond by
+    appender threads; follower_status/oplog_seq must read it locked."""
+    from hstream_tpu.store.memstore import MemLogStore
+    from hstream_tpu.store.replica import ReplicatedStore
+
+    store = ReplicatedStore(MemLogStore(), [], replication_factor=1)
+    try:
+        store.create_log(42)
+        store.append_batch(42, [b"x"])
+        store._cond = _TrackingLock(store._cond)
+        before = store._cond.entered
+        seq = store.oplog_seq
+        assert seq >= 2  # create + append both logged
+        assert store._cond.entered == before + 1
+    finally:
+        store.close()
+
+
+def test_credit_available_reads_under_cv():
+    """lock-guard fix: CreditWindow._avail is mutated under _cv by the
+    dispatcher and ack threads; the gauge read must hold it."""
+    from hstream_tpu.flow import CreditWindow
+
+    cw = CreditWindow(8)
+    assert cw.take_up_to(3) == 3
+    cw._cv = _TrackingLock(cw._cv)
+    before = cw._cv.entered
+    assert cw.available == 5
+    assert cw._cv.entered == before + 1
+
+
+def test_store_dir_bytes_walk_is_ttl_bounded(tmp_path, monkeypatch):
+    """blocking-hot fix: the scrape-path store-footprint walk runs at
+    most once per TTL — pre-fix every /metrics hit walked the whole
+    store directory tree."""
+    import os as _os
+
+    from hstream_tpu.stats import prometheus as prom
+
+    (tmp_path / "seg1.dat").write_bytes(b"x" * 10)
+    (tmp_path / "wal.log").write_bytes(b"y" * 4)
+    prom._dir_bytes_cache.clear()
+    calls = {"n": 0}
+    real_walk = _os.walk
+
+    def counting_walk(*a, **kw):
+        calls["n"] += 1
+        return real_walk(*a, **kw)
+
+    monkeypatch.setattr(prom.os, "walk", counting_walk)
+    assert prom._store_dir_bytes(str(tmp_path)) == (10, 4)
+    assert prom._store_dir_bytes(str(tmp_path)) == (10, 4)
+    assert calls["n"] == 1, "second scrape inside the TTL re-walked"
+    # expiry: age the cache entry past the TTL -> one more walk
+    ts, val = prom._dir_bytes_cache[str(tmp_path)]
+    prom._dir_bytes_cache[str(tmp_path)] = (
+        ts - prom._DIR_BYTES_TTL_S - 1, val)
+    prom._store_dir_bytes(str(tmp_path))
+    assert calls["n"] == 2
+    prom._dir_bytes_cache.clear()
+
+
+def test_retry_policy_honors_classification():
+    """err-retry-class fix: retryability is an explicit table now.
+    Only RESOURCE_EXHAUSTED (a pre-work refusal, duplication-safe)
+    retries; NOT_FOUND and a mid-call UNAVAILABLE (which may have
+    landed a mutation without a response) fail on the first attempt."""
+    from hstream_tpu.client.retry import RetryPolicy, is_retryable
+
+    class FakeErr(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+        def details(self):
+            return ""
+
+        def trailing_metadata(self):
+            return ()
+
+    assert is_retryable(grpc.StatusCode.RESOURCE_EXHAUSTED)
+    assert not is_retryable(grpc.StatusCode.NOT_FOUND)
+    assert not is_retryable(grpc.StatusCode.INTERNAL)
+    # a mid-call transport drop may have landed a mutation: a blind
+    # resend could duplicate it, so it is classified non-retryable
+    assert not is_retryable(grpc.StatusCode.UNAVAILABLE)
+
+    attempts = {"n": 0}
+
+    def throttled():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise FakeErr(grpc.StatusCode.RESOURCE_EXHAUSTED)
+        return "ok"
+
+    pol = RetryPolicy(attempts=5, sleep=lambda s: None)
+    assert pol.call(throttled) == "ok"
+    assert pol.retries == 2
+
+    for code in (grpc.StatusCode.NOT_FOUND, grpc.StatusCode.UNAVAILABLE):
+        attempts["n"] = 0
+
+        def hard():
+            attempts["n"] += 1
+            raise FakeErr(code)
+
+        with pytest.raises(grpc.RpcError):
+            pol.call(hard)
+        assert attempts["n"] == 1, f"{code} must not retry"

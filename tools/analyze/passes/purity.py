@@ -1,0 +1,229 @@
+"""JAX purity pass.
+
+Functions handed to `jax.jit` / `jax.shard_map` (decorator or direct
+call, including `partial(jax.jit, ...)`) execute as traced programs:
+they run ONCE per shape specialization, then replay as compiled XLA.
+Side effects silently freeze at trace time — a `time.time()` call
+becomes a constant, a `random.random()` the same draw forever, a log
+line fires once per compile, and mutation of closed-over Python state
+happens at trace time only. `jax-impure` flags those inside any
+jitted/shard_map'd function:
+
+  * Python RNG / wall-clock / logging / print / file I/O calls;
+  * `global` / `nonlocal` rebinding;
+  * in-place mutation (`.append`/`.update`/subscript-store/attribute-
+    store) of closed-over or `self` state.
+
+`jax-donated-reuse` tracks the repo's donation idiom: a step built by
+`compiled_encoded_step(..., donate_words=True)` DONATES its wire-buffer
+argument — the device aliases its memory for the output, so the buffer
+is dead the moment the call dispatches. Loading the same variable after
+the donating call reads freed device memory (XLA raises at best,
+corrupts at worst).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import Finding
+from tools.analyze.passes import call_name, dotted
+
+NAME = "purity"
+
+RULES = {
+    "jax-impure": (
+        "function traced by jax.jit/shard_map calls RNG/time/logging/"
+        "I-O or mutates closed-over state — the effect freezes at "
+        "trace time instead of running per step"),
+    "jax-donated-reuse": (
+        "buffer passed to a donate_words=True compiled step is donated "
+        "(device memory aliased to the output); using it after the "
+        "call reads freed memory"),
+}
+
+_IMPURE_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep",
+    "time.time_ns", "datetime.now", "datetime.datetime.now",
+    "print", "input", "open",
+}
+_IMPURE_PREFIX = ("random.", "np.random.", "numpy.random.",
+                  "logging.", "log.", "logger.")
+_MUTATORS = {"append", "extend", "insert", "update", "add", "pop",
+             "popitem", "clear", "setdefault", "remove", "discard",
+             "appendleft", "write"}
+
+
+def _jitted_functions(tree: ast.Module):
+    """Yield (FunctionDef, how) for functions compiled by jit/shard_map:
+    decorated directly, via partial(jax.jit, ...), or passed by name to
+    a jit/shard_map call anywhere in the module."""
+    defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def is_jit_name(name: str | None) -> bool:
+        return bool(name) and (name.split(".")[-1] in ("jit", "shard_map")
+                               or name.endswith(".pjit"))
+
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(d)
+                inner = None
+                if isinstance(dec, ast.Call) and name and \
+                        name.split(".")[-1] == "partial" and dec.args:
+                    inner = dotted(dec.args[0])
+                if is_jit_name(name) or is_jit_name(inner):
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        yield node, "decorator"
+        elif isinstance(node, ast.Call) and is_jit_name(call_name(node)):
+            args = list(node.args)
+            # jax.jit(shard_map(f, ...)) — unwrap nested compile calls
+            while args and isinstance(args[0], ast.Call) \
+                    and is_jit_name(call_name(args[0])):
+                args = list(args[0].args)
+            if args and isinstance(args[0], ast.Name):
+                for fn in defs_by_name.get(args[0].id, ()):
+                    if id(fn) not in seen:
+                        seen.add(id(fn))
+                        yield fn, "jit call"
+
+
+def _local_names(fn: ast.FunctionDef) -> set[str]:
+    out = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                           + fn.args.kwonlyargs)}
+    if fn.args.vararg:
+        out.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        out.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            t = node.target
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                out.add(node.name)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _scan_jitted(src, fn: ast.FunctionDef) -> list[Finding]:
+    out: list[Finding] = []
+    local = _local_names(fn)
+    where = f"jitted fn {fn.name}"
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            out.append(Finding(
+                "jax-impure", src.rel, node.lineno,
+                f"{where} rebinds "
+                f"{'/'.join(node.names)} via "
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+            ))
+        elif isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            if name in _IMPURE_CALLS or name.startswith(_IMPURE_PREFIX):
+                out.append(Finding(
+                    "jax-impure", src.rel, node.lineno,
+                    f"{where} calls {name}() — effect freezes at "
+                    f"trace time"))
+            else:
+                leaf = name.split(".")[-1]
+                root = name.split(".")[0] if name else ""
+                if (leaf in _MUTATORS and root
+                        and root not in local and "." in name):
+                    out.append(Finding(
+                        "jax-impure", src.rel, node.lineno,
+                        f"{where} mutates closed-over "
+                        f"'{name.rsplit('.', 1)[0]}' via .{leaf}()"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    d = dotted(t) or t.attr
+                    root = d.split(".")[0]
+                    if root == "self" or root not in local:
+                        out.append(Finding(
+                            "jax-impure", src.rel, t.lineno,
+                            f"{where} stores to closed-over "
+                            f"attribute '{d}'"))
+                elif isinstance(t, ast.Subscript):
+                    d = dotted(t.value)
+                    root = (d or "").split(".")[0]
+                    if d and root not in local:
+                        out.append(Finding(
+                            "jax-impure", src.rel, t.lineno,
+                            f"{where} stores into closed-over "
+                            f"'{d}' by subscript"))
+    return out
+
+
+def _donation_findings(src) -> list[Finding]:
+    """Per function: find `S = ...compiled_encoded_step(...,
+    donate_words=True)`, then `S(..., buf)` — any load of `buf`'s
+    expression after that call line is a use-after-donation."""
+    out: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        donating_steps: set[str] = set()
+        donated: dict[str, int] = {}  # expr repr -> donation line
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                cn = call_name(stmt.value) or ""
+                if cn.split(".")[-1] == "compiled_encoded_step" and any(
+                        kw.arg == "donate_words"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in stmt.value.keywords):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            donating_steps.add(t.id)
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Call) and \
+                    isinstance(stmt.func, ast.Name) and \
+                    stmt.func.id in donating_steps and stmt.args:
+                d = dotted(stmt.args[-1])
+                if d:
+                    # a multiline call's own args sit past .lineno; only
+                    # loads past the call's END are uses-after-donation
+                    donated[d] = stmt.end_lineno or stmt.lineno
+        for d, line in donated.items():
+            for sub in ast.walk(node):
+                if (isinstance(sub, (ast.Name, ast.Attribute))
+                        and isinstance(getattr(sub, "ctx", None), ast.Load)
+                        and dotted(sub) == d and sub.lineno > line):
+                    out.append(Finding(
+                        "jax-donated-reuse", src.rel, sub.lineno,
+                        f"'{d}' read after being donated to the "
+                        f"compiled step at line {line}"))
+    return out
+
+
+def run(files, repo) -> list[Finding]:
+    out: list[Finding] = []
+    for src in files:
+        for fn, _how in _jitted_functions(src.tree):
+            out.extend(_scan_jitted(src, fn))
+        out.extend(_donation_findings(src))
+    return out

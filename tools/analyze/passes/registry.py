@@ -1,0 +1,169 @@
+"""Registry pass: the observability registries match their call sites
+(absorbs tools/metrics_lint.py — ISSUE 3's X-macro-discipline lint).
+
+The reference gets this for free: a metric exists iff its `.inc` line
+compiles. Python defers the mistake to runtime (a KeyError on a cold
+path, or a histogram nobody ever looks for), so the pass restores the
+compile-time property in both directions:
+
+  registry-unknown  a `stream_stat_add` / `time_series_add` /
+                    `gauge_set` / `gauge_fn` / `observe` /
+                    `events.append(kind, ...)` call site whose metric
+                    argument is a string literal names a metric absent
+                    from the registries (hstream_tpu/stats);
+  registry-dead     a registered metric / event kind is referenced by
+                    no call site anywhere in production code — dead
+                    registry entries rot dashboards (this is how the
+                    dead `append_failed` counter was found in PR 3).
+
+Dynamic call sites (metric passed as a variable) are skipped — those
+hit the registries' own KeyError at runtime. Literal mentions inside
+the registry/exposition modules and tools/ give no liveness credit.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+
+from tools.analyze import Finding
+
+NAME = "registry"
+
+RULES = {
+    "registry-unknown": (
+        "metric/event call site names a string literal absent from "
+        "the stats registries — a typo that would KeyError on a cold "
+        "path"),
+    "registry-dead": (
+        "registered metric/event kind referenced by no production "
+        "call site — a dead registry entry"),
+}
+
+COUNTER_CALLS = {"stream_stat_add", "stream_stat_get",
+                 "stream_stat_getall"}
+TS_CALLS = {"time_series_add", "time_series_get_rate",
+            "time_series_peek_rate", "time_series_streams", "_ts"}
+GAUGE_CALLS = {"gauge_set", "gauge_fn", "gauge_drop", "gauge_labels"}
+HIST_CALLS = {"observe", "histogram_percentile", "_hist"}
+
+# files whose literals do NOT count as "referenced" for the dead-entry
+# check: the registries themselves, the exposition layer (HELP text
+# names every metric), and tools (a metric only lint mentions is still
+# dead in production)
+_NO_REFERENCE_CREDIT = (
+    "hstream_tpu/stats/__init__.py",
+    "hstream_tpu/stats/events.py",
+    "hstream_tpu/stats/prometheus.py",
+    "tools",
+)
+
+REGISTRY_FILE = "hstream_tpu/stats/__init__.py"
+
+
+def _registries(repo: str) -> dict[str, set[str]]:
+    """Import the live registries from the tree under analysis."""
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from hstream_tpu.stats import (
+        GAUGES,
+        HISTOGRAMS,
+        PER_STREAM_COUNTERS,
+        PER_STREAM_TIME_SERIES,
+    )
+    from hstream_tpu.stats.events import EVENT_KINDS
+
+    return {
+        "counter": set(PER_STREAM_COUNTERS),
+        "time_series": {name for name, _ in PER_STREAM_TIME_SERIES},
+        "gauge": set(GAUGES),
+        "histogram": {name for name, _b, _l in HISTOGRAMS},
+        "event": set(EVENT_KINDS),
+    }
+
+
+_CALL_KIND: dict[str, str] = {}
+for _n in COUNTER_CALLS:
+    _CALL_KIND[_n] = "counter"
+for _n in TS_CALLS:
+    _CALL_KIND[_n] = "time_series"
+for _n in GAUGE_CALLS:
+    _CALL_KIND[_n] = "gauge"
+for _n in HIST_CALLS:
+    _CALL_KIND[_n] = "histogram"
+
+
+def _method_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_events_append(call: ast.Call) -> bool:
+    """`<something>.events.append(...)` / `journal.append(...)` /
+    `self._journal(...)`: the event-kind call shapes used in-tree."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "append":
+        base = fn.value
+        base_name = (base.attr if isinstance(base, ast.Attribute)
+                     else base.id if isinstance(base, ast.Name) else "")
+        return base_name in ("events", "journal", "_events", "_ring")
+    if isinstance(fn, ast.Attribute) and fn.attr == "_journal":
+        return True
+    return False
+
+
+def run(files, repo) -> list[Finding]:
+    registries = _registries(repo)
+    out: list[Finding] = []
+    referenced: dict[str, set[str]] = {k: set() for k in registries}
+    all_names = {n for names in registries.values() for n in names}
+    for src in files:
+        if not src.rel.startswith(_NO_REFERENCE_CREDIT):
+            # dead-entry credit: ANY literal mention in production code
+            # (call sites, routing dicts like handlers._RPC_HISTOGRAMS)
+            for node in ast.walk(src.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in all_names):
+                    for kind, names in registries.items():
+                        if node.value in names:
+                            referenced[kind].add(node.value)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue  # dynamic name: runtime KeyError covers it
+            name = _method_name(node)
+            kind = _CALL_KIND.get(name or "")
+            if kind is not None:
+                metric = first.value
+                if metric in registries[kind]:
+                    referenced[kind].add(metric)
+                else:
+                    out.append(Finding(
+                        "registry-unknown", src.rel, node.lineno,
+                        f"{name}({metric!r}, ...) names an "
+                        f"unregistered {kind} metric"))
+            elif _is_events_append(node):
+                event = first.value
+                if event in registries["event"]:
+                    referenced["event"].add(event)
+                else:
+                    out.append(Finding(
+                        "registry-unknown", src.rel, node.lineno,
+                        f"events.append({event!r}) names an "
+                        f"unregistered event kind"))
+    # direction 2: registered but never referenced anywhere
+    for kind, names in sorted(registries.items()):
+        for name in sorted(names - referenced[kind]):
+            out.append(Finding(
+                "registry-dead", REGISTRY_FILE, 1,
+                f"{kind} metric {name!r} is registered but never "
+                f"referenced by any call site"))
+    return out

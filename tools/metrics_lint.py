@@ -1,188 +1,30 @@
 #!/usr/bin/env python
-"""Static registry check for the observability plane (ISSUE 3).
+"""DEPRECATED shim: the metrics registry lint moved into the static
+analysis suite as `tools/analyze/passes/registry.py` (ISSUE 4).
 
-The reference gets its X-macro discipline for free: a metric exists iff
-its `.inc` line does, so a typo'd call site fails to compile. Python
-would defer that mistake to runtime (a KeyError on a cold code path,
-or worse — a histogram nobody ever looks for). This lint restores the
-compile-time property, in both directions:
+Equivalent invocation:
 
-  1. every `stream_stat_add` / `time_series_add` / `gauge_set` /
-     `gauge_fn` / `observe` / `events.append(kind, ...)` call site
-     whose metric argument is a string literal must name a metric
-     present in the registries (hstream_tpu/stats);
-  2. every registered metric / event kind must be referenced by at
-     least one such call site somewhere in the tree — dead registry
-     entries rot dashboards.
+    python -m tools.analyze --only registry
 
-Dynamic call sites (metric passed as a variable) are skipped — those
-hit the registries' own KeyError at runtime, which the holder raises
-on every unregistered name.
-
-Run from the repo root (CI runs it in the fast tier-1 job):
-
-    python tools/metrics_lint.py
+This forwarder stays so older scripts/docs keep working; it warns and
+delegates, exit code preserved.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from hstream_tpu.stats import (  # noqa: E402
-    GAUGES,
-    HISTOGRAMS,
-    PER_STREAM_COUNTERS,
-    PER_STREAM_TIME_SERIES,
-)
-from hstream_tpu.stats.events import EVENT_KINDS  # noqa: E402
-
-# call-method name -> (registry, registry display name)
-COUNTER_CALLS = {"stream_stat_add", "stream_stat_get",
-                 "stream_stat_getall"}
-TS_CALLS = {"time_series_add", "time_series_get_rate",
-            "time_series_peek_rate", "time_series_streams", "_ts"}
-GAUGE_CALLS = {"gauge_set", "gauge_fn", "gauge_drop", "gauge_labels"}
-HIST_CALLS = {"observe", "histogram_percentile", "_hist"}
-
-REGISTRIES = {
-    "counter": set(PER_STREAM_COUNTERS),
-    "time_series": {name for name, _ in PER_STREAM_TIME_SERIES},
-    "gauge": set(GAUGES),
-    "histogram": {name for name, _b, _l in HISTOGRAMS},
-    "event": set(EVENT_KINDS),
-}
-
-_CALL_KIND = {}
-for n in COUNTER_CALLS:
-    _CALL_KIND[n] = "counter"
-for n in TS_CALLS:
-    _CALL_KIND[n] = "time_series"
-for n in GAUGE_CALLS:
-    _CALL_KIND[n] = "gauge"
-for n in HIST_CALLS:
-    _CALL_KIND[n] = "histogram"
-
-SCAN_ROOTS = ("hstream_tpu", "tools", "bench.py")
-
-
-def _py_files() -> list[str]:
-    out = []
-    for root in SCAN_ROOTS:
-        p = os.path.join(REPO, root)
-        if os.path.isfile(p):
-            out.append(p)
-            continue
-        for dirpath, _dirs, files in os.walk(p):
-            out.extend(os.path.join(dirpath, f) for f in files
-                       if f.endswith(".py"))
-    return out
-
-
-def _method_name(call: ast.Call) -> str | None:
-    fn = call.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
-
-
-def _is_events_append(call: ast.Call) -> bool:
-    """`<something>.events.append(...)` / `journal.append(...)` /
-    `self._journal(...)`: the event-kind call shapes used in-tree.
-    Plain list .append(...) is excluded by requiring the kind literal
-    to BE a registered-looking string (checked by the caller)."""
-    fn = call.func
-    if isinstance(fn, ast.Attribute) and fn.attr == "append":
-        base = fn.value
-        base_name = (base.attr if isinstance(base, ast.Attribute)
-                     else base.id if isinstance(base, ast.Name) else "")
-        return base_name in ("events", "journal", "_events", "_ring")
-    if isinstance(fn, ast.Attribute) and fn.attr == "_journal":
-        return True
-    return False
-
-
-# files whose literals do NOT count as "referenced" for the dead-entry
-# check: the registries themselves, the exposition layer (HELP text
-# names every metric), and tools (a metric only this lint mentions is
-# still dead in production). tests/ are not scanned at all — they
-# deliberately exercise the unregistered-name KeyError paths.
-_NO_REFERENCE_CREDIT = (
-    os.path.join("hstream_tpu", "stats", "__init__.py"),
-    os.path.join("hstream_tpu", "stats", "events.py"),
-    os.path.join("hstream_tpu", "stats", "prometheus.py"),
-    "tools",
-)
-
 
 def lint() -> int:
-    errors: list[str] = []
-    referenced: dict[str, set[str]] = {k: set() for k in REGISTRIES}
-    all_names = {n for names in REGISTRIES.values() for n in names}
-    for path in _py_files():
-        rel = os.path.relpath(path, REPO)
-        try:
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=rel)
-        except SyntaxError as e:
-            errors.append(f"{rel}: syntax error: {e}")
-            continue
-        if not rel.startswith(_NO_REFERENCE_CREDIT):
-            # dead-entry credit: ANY literal mention in production code
-            # (call sites, routing dicts like handlers._RPC_HISTOGRAMS)
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Constant)
-                        and isinstance(node.value, str)
-                        and node.value in all_names):
-                    for kind, names in REGISTRIES.items():
-                        if node.value in names:
-                            referenced[kind].add(node.value)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            first = node.args[0]
-            if not (isinstance(first, ast.Constant)
-                    and isinstance(first.value, str)):
-                continue  # dynamic metric name: runtime KeyError covers it
-            name = _method_name(node)
-            kind = _CALL_KIND.get(name or "")
-            if kind is not None:
-                metric = first.value
-                if metric in REGISTRIES[kind]:
-                    referenced[kind].add(metric)
-                else:
-                    errors.append(
-                        f"{rel}:{node.lineno}: {name}({metric!r}, ...) "
-                        f"names an unregistered {kind} metric")
-            elif _is_events_append(node):
-                event = first.value
-                if event in REGISTRIES["event"]:
-                    referenced["event"].add(event)
-                else:
-                    errors.append(
-                        f"{rel}:{node.lineno}: events.append({event!r}) "
-                        f"names an unregistered event kind")
-    # direction 2: registered but never referenced anywhere
-    for kind, names in REGISTRIES.items():
-        for name in sorted(names - referenced[kind]):
-            errors.append(
-                f"registry: {kind} metric {name!r} is registered but "
-                f"never referenced by any call site")
-    if errors:
-        print(f"metrics_lint: {len(errors)} problem(s)")
-        for e in errors:
-            print(f"  {e}")
-        return 1
-    n = sum(len(v) for v in referenced.values())
-    print(f"metrics_lint: OK ({n} registered metrics/kinds, "
-          f"all call sites registered, no dead registry entries)")
-    return 0
+    print("metrics_lint: DEPRECATED — use "
+          "`python -m tools.analyze --only registry`", file=sys.stderr)
+    from tools.analyze import main
+
+    return main(["--only", "registry"])
 
 
 if __name__ == "__main__":
