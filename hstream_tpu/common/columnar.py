@@ -20,6 +20,7 @@ kinds: "f32" | "i64" | "bool" | "str" (i32 ids into header dict)
 from __future__ import annotations
 
 import json
+from collections.abc import Sequence
 from typing import Any, Mapping
 
 import numpy as np
@@ -184,6 +185,91 @@ def payload_rows(payload: bytes) -> list[dict[str, Any]] | None:
     return to_rows(ts, cols)
 
 
+class ColumnarEmit(Sequence):
+    """A batch of emitted aggregate rows kept COLUMNAR until the wire.
+
+    The window-close path finalizes whole slot columns on device; this
+    carries the result as named columns (numpy arrays, or object arrays
+    for strings / TOPK lists) instead of N per-row dicts. Consumers that
+    can stay columnar (the stream sink's columnar record, the native
+    codec) read `.cols` / `to_payload()` directly; everything else sees
+    a lazy Sequence of per-row dicts identical to the legacy list shape
+    (len / bool / iterate / index / extend-into-a-list all work), so the
+    row materialization happens at most once, at the first row-shaped
+    consumer — ideally the wire boundary.
+    """
+
+    __slots__ = ("cols", "n", "_rows")
+
+    def __init__(self, cols: Mapping[str, Any], n: int):
+        self.cols = dict(cols)
+        self.n = int(n)
+        self._rows: list[dict[str, Any]] | None = None
+
+    def __len__(self) -> int:
+        return self.n
+
+    def rows(self) -> list[dict[str, Any]]:
+        """Materialize (and cache) the per-row dict view."""
+        if self._rows is None:
+            names = list(self.cols)
+            if not names:
+                self._rows = [{} for _ in range(self.n)]
+            else:
+                pyd = [v.tolist() if isinstance(v, np.ndarray) else list(v)
+                       for v in self.cols.values()]
+                self._rows = [dict(zip(names, vals))
+                              for vals in zip(*pyd)]
+        return self._rows
+
+    def __getitem__(self, i):
+        return self.rows()[i]
+
+    def __iter__(self):
+        return iter(self.rows())
+
+    def __repr__(self) -> str:
+        return (f"ColumnarEmit(n={self.n}, "
+                f"cols={list(self.cols)})")
+
+    def to_payload(self, ts_ms: int) -> bytes | None:
+        """ONE columnar wire record for the whole batch, straight from
+        the columns (no per-row dicts); None when a column is not
+        wire-encodable (TOPK lists, mixed/None values) — the caller
+        falls back to per-row records."""
+        if self.n == 0:
+            return None
+        wire: dict[str, np.ndarray] = {}
+        for name, v in self.cols.items():
+            arr = np.asarray(v) if not isinstance(v, np.ndarray) else v
+            if arr.dtype.kind == "O":
+                if not all(isinstance(x, str) for x in arr.tolist()):
+                    return None  # None / lists -> per-row records
+            elif arr.dtype.kind == "f":
+                arr = arr.astype(np.float64, copy=False)
+            elif arr.dtype.kind not in ("i", "u", "b", "U", "S"):
+                return None
+            wire[name] = arr
+        ts = np.full(self.n, int(ts_ms), np.int64)
+        return encode_columnar(ts, wire, float_kind="f64")
+
+
+def extend_rows(acc, rows):
+    """Accumulate emitted row batches across pipeline stages while
+    keeping a LONE ColumnarEmit columnar: acc is None | list |
+    ColumnarEmit; returns the new accumulator. Only when a second batch
+    arrives does the first materialize into a plain list — the common
+    case (one close cycle per drain) reaches the sink columnar."""
+    if rows is None or len(rows) == 0:
+        return acc
+    if acc is None or (isinstance(acc, list) and not acc):
+        return rows
+    if not isinstance(acc, list):
+        acc = list(acc)
+    acc.extend(rows)
+    return acc
+
+
 def rows_to_payload(rows: list[Mapping[str, Any]],
                     ts_ms: int) -> bytes | None:
     """One columnar payload for a homogeneous batch of flat scalar rows
@@ -194,7 +280,10 @@ def rows_to_payload(rows: list[Mapping[str, Any]],
     Emitting the sink batch as ONE columnar record instead of N protobuf
     Structs keeps the server's emit stage off the per-row Python path
     (the reference serializes one protobuf per sunk record,
-    HStore.hs:152-163)."""
+    HStore.hs:152-163). A ColumnarEmit batch encodes straight from its
+    columns — no per-row dicts at all."""
+    if isinstance(rows, ColumnarEmit):
+        return rows.to_payload(ts_ms)
     if not rows:
         return None
     names = list(rows[0])

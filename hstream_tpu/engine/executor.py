@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hstream_tpu.common.columnar import ColumnarEmit, extend_rows
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.engine import lattice, transport
 from hstream_tpu.engine.expr import (
@@ -45,6 +46,7 @@ from hstream_tpu.engine.expr import (
     columns_of,
     encode_strings,
     eval_host,
+    eval_host_vec,
 )
 from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec
 from hstream_tpu.engine.types import (
@@ -197,12 +199,24 @@ class QueryExecutor:
         self._touched_this_call: set[int] = set()
         self.rebase_threshold = REBASE_THRESHOLD
         # Deferred close decode: when True, closing a window dispatches
-        # extract+reset on device but keeps the packed result as a device
-        # value; drain_closed() decodes them later. This keeps the hot
-        # ingest loop free of forced device->host syncs (pull-based
-        # emission — the TPU analogue of the reference's sink append).
+        # the fused extract+reset on device but keeps the packed result
+        # as a device value; drain_closed() decodes them later. This
+        # keeps the hot ingest loop free of forced device->host syncs
+        # (pull-based emission — the TPU analogue of the reference's
+        # sink append). Each entry is (window starts, packed [P,rows,K]).
         self.defer_close_decode = False
-        self._pending_closes: list[tuple[int, Any]] = []
+        self._pending_closes: list[tuple[list[int], Any]] = []
+        # close-path dispatch accounting: the fused close contract is
+        # ONE lattice-kernel dispatch and (outside changelog mode) ONE
+        # device->host fetch per close cycle, regardless of how many
+        # windows are due — tests and bench assert on these
+        self.close_stats = {"close_cycles": 0, "close_dispatches": 0,
+                            "close_fetches": 0}
+        # cached reverse key-index columns for vectorized key decode:
+        # (version = len(_key_rev) when built, [object array per group
+        # column]); _key_rev is append-only so a stale cache is only
+        # ever too short
+        self._key_cols_cache: tuple[int, list[np.ndarray]] = (0, [])
         # Deferred CHANGE decode (emit_changes mode): keep the touched
         # extract as a device value and decode it one batch later, so
         # the blocking device->host fetch overlaps the next batch's host
@@ -266,8 +280,15 @@ class QueryExecutor:
                       self.spec.n_keys * self.spec.n_slots)
         fns = lattice.compiled(self.spec, self.schema, self._filter_expr,
                                max_out, self._layout)
-        self._extract_slot = fns.extract_slot
-        self._reset_slot = fns.reset_slot
+        # close-path kernels are wrapped so close_stats counts ACTUAL
+        # device dispatches at the call sites — a reintroduced
+        # per-slot close loop shows up as dispatches > cycles
+        self._extract_slot = self._count_close_kernel(fns.extract_slot)
+        self._reset_slot = self._count_close_kernel(fns.reset_slot)
+        self._extract_reset_slots = self._count_close_kernel(
+            fns.extract_reset_slots)
+        self._extract_slots = fns.extract_slots  # peek: read path
+        self._reset_slots = self._count_close_kernel(fns.reset_slots)
         self._extract_touched = fns.extract_touched
         # (null-flag stream name, referenced columns) per null-tracked agg
         self._null_specs = [
@@ -275,6 +296,17 @@ class QueryExecutor:
             for key, agg in zip(fns.null_keys, self.spec.aggs)
             if key is not None
         ]
+
+    def _count_close_kernel(self, fn):
+        """Wrap a close-path lattice kernel so every device dispatch
+        bumps close_stats — the accounting the one-dispatch-per-cycle
+        contract is asserted against (tests/test_close_batched.py)."""
+
+        def counted(*args):
+            self.close_stats["close_dispatches"] += 1
+            return fn(*args)
+
+        return counted
 
     def _run_step(self, cap: int, n: int, key_ids, ts_rel, cols,
                   valid, null_streams, wm_rel) -> None:
@@ -474,8 +506,7 @@ class QueryExecutor:
                         "aliasing window not due — slot layout invariant "
                         "broken")
                 self.watermark_abs = max(self.watermark_abs, boundary)
-                for s in sorted(collide):
-                    out.extend(self._close_window(s))
+                out.extend(self._close_windows(sorted(collide)))
             out.extend(sub(suf))
             return out, None
         if int(ts_arr.max()) > horizon:
@@ -544,9 +575,8 @@ class QueryExecutor:
 
         if self.emit_changes:
             out.extend(self._drain_changes())
-        out_closed = self.close_due_windows()
-        out.extend(out_closed)
-        return out
+        # a lone closed batch stays columnar all the way to the caller
+        return extend_rows(out, self.close_due_windows()) or out
 
     def _track_windows(self, ts_abs: np.ndarray,
                        starts: set[int] | None = None) -> None:
@@ -661,8 +691,7 @@ class QueryExecutor:
             self.watermark_abs = max_ts
         if self.emit_changes:
             out.extend(self._drain_changes())
-        out.extend(self.close_due_windows())
-        return out
+        return extend_rows(out, self.close_due_windows()) or out
 
     # ---- pipelined ingest (stage on one thread, step on another) ----------
 
@@ -799,8 +828,7 @@ class QueryExecutor:
             self.watermark_abs = staged.ts_max
         if self.emit_changes:
             out.extend(self._drain_changes())
-        out.extend(self.close_due_windows())
-        return out
+        return extend_rows(out, self.close_due_windows()) or out
 
     def key_id_for(self, key: tuple) -> int:
         """Dense id for a group-key tuple (columnar-path key dictionary).
@@ -952,97 +980,198 @@ class QueryExecutor:
                 rows.append(row)
         return rows
 
-    def _close_window(self, start: int) -> list[dict[str, Any]]:
-        """Pop + extract (unless changelog mode) + reset one open window."""
-        ow = self._open.pop(start)
+    def _pad_slots(self, slots: list[int]) -> np.ndarray:
+        """Due-slot vector padded (with -1) to a power of two, so close
+        cycles of varying width share a handful of compiled shapes
+        instead of one XLA executable per distinct due-count."""
+        p = 1
+        while p < len(slots):
+            p *= 2
+        out = np.full(p, -1, np.int32)
+        out[:len(slots)] = slots
+        return out
+
+    def _close_windows(self, starts: list[int]) -> list[dict[str, Any]]:
+        """Pop + close every window in `starts` with ONE fused
+        extract+reset dispatch (the close-cycle contract: one lattice
+        kernel and one device->host fetch regardless of how many
+        windows are due)."""
+        if not starts:
+            return []
+        slots = self._pad_slots([self._open.pop(s).slot for s in starts])
+        self.close_stats["close_cycles"] += 1
         if self.emit_changes:
-            rows = []
-        elif self.defer_close_decode:
-            # dispatch the extract, keep the device value; no host sync
-            self._pending_closes.append(
-                (ow.start_abs,
-                 self._extract_slot(self.state, np.int32(ow.slot))))
+            # the changelog already carried final values: batched reset
+            # only, no extract and no fetch
+            self.state = self._reset_slots(self.state, slots)
             rows = []
         else:
-            rows = self._extract_window_rows(ow)
-        self.state = self._reset_slot(self.state, np.int32(ow.slot))
-        self._no_close.discard(start)
+            self.state, packed = self._extract_reset_slots(self.state,
+                                                           slots)
+            if self.defer_close_decode:
+                # keep the packed batch as a device value; no host sync
+                self._pending_closes.append((list(starts), packed))
+                rows = []
+            else:
+                self.close_stats["close_fetches"] += 1
+                rows = self._decode_extract_batch(np.asarray(packed),
+                                                  starts)
+        for s in starts:
+            self._no_close.discard(s)
         return rows
 
     def drain_closed(self) -> list[dict[str, Any]]:
         """Decode every deferred window close (forces the device queue).
-        Multiple pending closes fetch in ONE device->host transfer —
-        fetch count, not bytes, dominates drain cost on real links."""
+        Multiple pending close cycles fetch in ONE device->host transfer
+        per buffer shape — fetch count, not bytes, dominates drain cost
+        on real links."""
         if not self._pending_closes:
             return []
-        rows: list[dict[str, Any]] = []
+        out = None
         if len(self._pending_closes) == 1:
-            start_abs, packed_dev = self._pending_closes[0]
-            rows = self._decode_extract(np.asarray(packed_dev), start_abs)
+            starts, packed_dev = self._pending_closes[0]
+            self.close_stats["close_fetches"] += 1
+            out = self._decode_extract_batch(np.asarray(packed_dev),
+                                             starts)
             self._pending_closes.clear()  # only after decode succeeded
-            return rows
+            return out if out is not None else []
         # Group by buffer shape: grow_keys() between two deferred closes
-        # changes the K dimension, and jnp.stack over mixed shapes raises.
-        by_shape: dict[tuple, list[tuple[int | None, Any]]] = {}
-        for start_abs, packed in self._pending_closes:
+        # changes the K dimension (and cycle width changes P), and
+        # jnp.stack over mixed shapes raises.
+        by_shape: dict[tuple, list[tuple[list[int], Any]]] = {}
+        for starts, packed in self._pending_closes:
             by_shape.setdefault(tuple(packed.shape), []).append(
-                (start_abs, packed))
+                (starts, packed))
         for group in by_shape.values():
-            starts = [s for s, _ in group]
+            self.close_stats["close_fetches"] += 1
             stacked = np.asarray(jnp.stack([p for _, p in group]))
-            for start_abs, packed in zip(starts, stacked):
-                rows.extend(self._decode_extract(packed, start_abs))
+            for (starts, _), packed in zip(group, stacked):
+                out = extend_rows(
+                    out, self._decode_extract_batch(packed, starts))
         self._pending_closes.clear()  # only after every decode succeeded
-        return rows
+        return out if out is not None else []
 
     def close_due_windows(self) -> list[dict[str, Any]]:
-        """Extract + reset every open window past end+grace. Host-driven."""
+        """Extract + reset every open window past end+grace: one fused
+        device dispatch + one fetch for the whole cycle. Host-driven."""
         if self.window is None or self.watermark_abs < 0:
             return []
         w = self.window
         due = [s for s in self._open
                if s + w.size_ms + w.grace_ms <= self.watermark_abs
                and s not in self._no_close]
-        rows: list[dict[str, Any]] = []
-        for s in sorted(due):
-            rows.extend(self._close_window(s))
-        return rows
+        return self._close_windows(sorted(due))
 
-    def _extract_window_rows(self, ow: _OpenWindow) -> list[dict[str, Any]]:
-        packed = np.asarray(self._extract_slot(self.state,
-                                               np.int32(ow.slot)))
-        return self._decode_extract(packed, ow.start_abs)
+    def _key_rev_columns(self) -> list[np.ndarray]:
+        """Per-group-column object arrays over the key dictionary, for
+        vectorized key decode (one gather per column instead of one
+        _decode_key dict per row). Rebuilt only when keys were added."""
+        version = len(self._key_rev)
+        if self._key_cols_cache[0] != version:
+            cols = []
+            for g in range(len(self.group_cols)):
+                arr = np.empty(version, object)
+                for i, key in enumerate(self._key_rev):
+                    arr[i] = key[g]
+                cols.append(arr)
+            self._key_cols_cache = (version, cols)
+        return self._key_cols_cache[1]
 
-    def _decode_extract(self, packed: np.ndarray,
-                        start_abs: int | None) -> list[dict[str, Any]]:
-        count, _start_rel, outs_np = lattice.unpack_extract_rows(
-            self.spec, packed)
-        rows = []
-        for kid in np.nonzero(count > 0)[0]:
-            row = self._agg_row(int(kid), outs_np, int(kid), start_abs)
+    def _decode_extract_batch(self, packed: np.ndarray,
+                              starts: Sequence[int | None]
+                              ) -> "ColumnarEmit | list[dict[str, Any]]":
+        """Vectorized decode of a batched extract buffer [P, 2+rows, K]
+        into a ColumnarEmit: key decode is a cached reverse-index
+        gather, agg finalization is columnar numpy, HAVING evaluates
+        columnwise — no per-kid Python loop. `starts[p]` is window p's
+        absolute start (None when windowless)."""
+        count = packed[:, 0, :]
+        widx, kids = np.nonzero(count > 0)
+        if len(widx) == 0:
+            return []
+        cols: dict[str, Any] = {}
+        for name, arr in zip(self.group_cols, self._key_rev_columns()):
+            cols[name] = arr[kids]
+        outs = lattice.gather_extract_batch(self.spec, packed, widx, kids)
+        for agg in self.spec.aggs:
+            v = outs[agg.out_name]
+            if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                finite = np.isfinite(v)
+                vals = np.empty(len(v), object)
+                vals[:] = [[float(x) for x in row[m]]
+                           for row, m in zip(v, finite)]
+                cols[agg.out_name] = vals
+            elif agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT,
+                              AggKind.APPROX_COUNT_DISTINCT):
+                cols[agg.out_name] = np.rint(v).astype(np.int64)
+            else:
+                cols[agg.out_name] = v
+        if self.window is not None and starts and starts[0] is not None:
+            ws = np.asarray(starts, np.int64)[widx]
+            cols["winStart"] = ws
+            cols["winEnd"] = ws + self.window.size_ms
+        return self._postprocess_cols(cols, len(widx))
+
+    def _postprocess_cols(self, cols: dict[str, Any], n: int
+                          ) -> "ColumnarEmit | list[dict[str, Any]]":
+        """HAVING + SELECT projections over a columnar batch. The
+        vectorized evaluator covers the numeric/comparison core; any
+        op outside it falls back to the per-row interpreter so
+        semantics match the legacy path exactly."""
+        if self.node.having is not None:
+            try:
+                keep = np.broadcast_to(
+                    np.asarray(eval_host_vec(self.node.having, cols),
+                               np.bool_), (n,))
+            except Exception:  # noqa: BLE001 — host-only op / NULLs:
+                return self._postprocess_rows(ColumnarEmit(cols, n))
+            if not keep.all():
+                cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
+                n = int(keep.sum())
+                if n == 0:
+                    return []
+        if self.node.post_projections:
+            try:
+                projected: dict[str, Any] = {}
+                for name, expr in self.node.post_projections:
+                    v = eval_host_vec(expr, cols)
+                    projected[name] = np.broadcast_to(
+                        np.asarray(v), (n,)) if np.ndim(v) == 0 \
+                        else np.asarray(v)
+                for meta in ("winStart", "winEnd"):
+                    if meta in cols:
+                        projected[meta] = cols[meta]
+                cols = projected
+            except Exception:  # noqa: BLE001
+                return self._postprocess_rows(ColumnarEmit(cols, n))
+        return ColumnarEmit(cols, n)
+
+    def _postprocess_rows(self, rows) -> list[dict[str, Any]]:
+        """Per-row HAVING/projection fallback (host-only ops)."""
+        out = []
+        for row in rows:
+            row = self._postprocess(row)
             if row is not None:
-                rows.append(row)
-        return rows
+                out.append(row)
+        return out
 
     # ---- pull queries (materialized views) ---------------------------------
 
     def peek(self) -> list[dict[str, Any]]:
         """Current (open-window) aggregate rows without resetting state —
         the live half of a materialized view; closed windows are kept by
-        the view store that owns this executor."""
-        rows: list[dict[str, Any]] = []
+        the view store that owns this executor. ONE batched extract
+        dispatch + ONE fetch covers every open window."""
         if self.window is None:
-            packed = np.asarray(self._extract_slot(self.state, np.int32(0)))
-            count, _s, outs_np = lattice.unpack_extract_rows(self.spec,
-                                                             packed)
-            for kid in np.nonzero(count > 0)[0]:
-                row = self._agg_row(int(kid), outs_np, int(kid), None)
-                if row is not None:
-                    rows.append(row)
-            return rows
-        for s in sorted(self._open):
-            rows.extend(self._extract_window_rows(self._open[s]))
-        return rows
+            packed = np.asarray(self._extract_slots(
+                self.state, self._pad_slots([0])))
+            return self._decode_extract_batch(packed, [None])
+        starts = sorted(self._open)
+        if not starts:
+            return []
+        slots = self._pad_slots([self._open[s].slot for s in starts])
+        packed = np.asarray(self._extract_slots(self.state, slots))
+        return self._decode_extract_batch(packed, starts)
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)

@@ -486,10 +486,35 @@ def unpack_extract_rows(spec: LatticeSpec, packed: np.ndarray):
     return count, win_start, _unpack_agg_rows(spec, packed[2:])
 
 
+def gather_extract_batch(spec: LatticeSpec, packed: np.ndarray,
+                         widx: np.ndarray, kids: np.ndarray):
+    """Columnar gather over a batched extract buffer [P, 2+rows, K]:
+    for the selected (window, key) pairs, return {out_name: [n] f64 or
+    [n, width] f32} — the vectorized inverse of per-row _agg_row
+    decoding. The fancy-index gather yields contiguous int32 vectors,
+    so the f32 bitcast is a view, not a copy-per-cell."""
+    outs: dict[str, np.ndarray] = {}
+    row = 2
+    for agg in spec.aggs:
+        w = agg_width(agg)
+        if agg.kind in _TOPK_KINDS:
+            outs[agg.out_name] = np.stack(
+                [np.ascontiguousarray(packed[widx, row + j, kids])
+                 .view(np.float32) for j in range(w)], axis=1)
+        else:
+            outs[agg.out_name] = np.ascontiguousarray(
+                packed[widx, row, kids]).view(np.float32).astype(
+                np.float64)
+        row += w
+    return outs
+
+
 def build_extract_slot(spec: LatticeSpec):
     """extract(state, slot) -> packed int32 [2+n_aggs, K] (see
     pack_extract_rows): finalized values for one slot column, fetched by
-    the host in a single transfer when the watermark closes a window."""
+    the host in a single transfer when the watermark closes a window.
+    Kept as the per-slot reference kernel (equivalence tests); the close
+    path itself dispatches build_extract_reset_slots."""
 
     @jax.jit
     def extract(state, slot):
@@ -517,6 +542,100 @@ def build_reset_slot(spec: LatticeSpec):
         out["touched"] = state["touched"].at[:, slot].set(False)
         out["slot_start"] = state["slot_start"].at[slot].set(EMPTY_START)
         return out
+
+    return reset
+
+
+# ---- fused multi-slot close -------------------------------------------------
+#
+# A close cycle may find many windows due at once (hopping windows, a
+# watermark jump, a deferred-close drain). Dispatching extract+reset per
+# slot costs 2 kernel launches + 1 device->host fetch PER WINDOW, and on
+# a tunneled link each is a round trip — the measured gap between
+# kernel_events_per_sec and end-to-end eps. The fused kernels below take
+# a PADDED slot vector (entries < 0 are padding) so one dispatch covers
+# every due window and the host pays ONE fetch for the whole cycle; the
+# extract is vmapped over slots and the reset is folded into the same
+# jit (it reads the pre-reset state, so extract values are unaffected).
+
+
+def _reset_slots_tree(spec: LatticeSpec, state, rs):
+    """Reset the slot columns named by rs (int32 [P]; out-of-range
+    entries drop) in every plane — shared by the fused extract+reset and
+    the reset-only kernel."""
+    out = dict(state)
+    for i, agg in enumerate(spec.aggs):
+        if agg.kind == AggKind.COUNT_ALL:
+            continue  # no own plane; `count` below resets it
+        name = _plane_name(i, agg)
+        out[name] = state[name].at[:, rs].set(init_value(agg), mode="drop")
+        if agg.kind == AggKind.AVG:
+            out[name + "_n"] = state[name + "_n"].at[:, rs].set(
+                0, mode="drop")
+    out["count"] = state["count"].at[:, rs].set(0, mode="drop")
+    out["touched"] = state["touched"].at[:, rs].set(False, mode="drop")
+    out["slot_start"] = state["slot_start"].at[rs].set(
+        EMPTY_START, mode="drop")
+    return out
+
+
+def _extract_slots_packed(spec: LatticeSpec, state, slots):
+    """Vmapped extract of the slot columns named by `slots` (padding
+    entries < 0 produce all-zero packed rows, so the host decode's
+    count>0 filter skips them) -> packed int32 [P, 2+rows, K]."""
+    valid = slots >= 0
+    safe = jnp.where(valid, slots, 0)
+
+    def one(slot):
+        col = {k: v[:, slot] for k, v in state.items()
+               if k not in ("slot_start", "touched")}
+        outs = finalize_column(spec, col)
+        return pack_extract_rows(spec, col["count"],
+                                 state["slot_start"][slot], outs)
+
+    packed = jax.vmap(one)(safe)
+    return jnp.where(valid[:, None, None], packed, 0)
+
+
+def build_extract_reset_slots(spec: LatticeSpec):
+    """extract_and_reset(state, slots i32[P]) ->
+    (state', packed i32[P, 2+rows, K]).
+
+    One device dispatch closes every due window: the vmapped extract
+    finalizes each requested slot column and the reset of those same
+    slots rides in the same jit (XLA schedules both off the pre-reset
+    state). Padding entries (slot < 0) extract zeros and reset nothing."""
+
+    @jax.jit
+    def extract_and_reset(state, slots):
+        packed = _extract_slots_packed(spec, state, slots)
+        rs = jnp.where(slots >= 0, slots, spec.n_slots)  # OOB -> drop
+        return _reset_slots_tree(spec, state, rs), packed
+
+    return extract_and_reset
+
+
+def build_extract_slots(spec: LatticeSpec):
+    """extract(state, slots i32[P]) -> packed i32[P, 2+rows, K]: the
+    read-only half of the fused close — one dispatch serves a pull
+    query / view peek over every open window."""
+
+    @jax.jit
+    def extract(state, slots):
+        return _extract_slots_packed(spec, state, slots)
+
+    return extract
+
+
+def build_reset_slots(spec: LatticeSpec):
+    """reset(state, slots i32[P]) -> state': batched reset without the
+    extract (EMIT CHANGES mode closes emit nothing — the changelog
+    already carried the final values)."""
+
+    @jax.jit
+    def reset(state, slots):
+        rs = jnp.where(slots >= 0, slots, spec.n_slots)
+        return _reset_slots_tree(spec, state, rs)
 
     return reset
 
@@ -624,8 +743,11 @@ def compile_agg_inputs(spec: LatticeSpec, schema) -> tuple[
 
 class CompiledLattice(NamedTuple):
     step: Callable
-    extract_slot: Callable
+    extract_slot: Callable      # per-slot reference kernels (tests)
     reset_slot: Callable
+    extract_reset_slots: Callable  # fused multi-slot close (one dispatch)
+    extract_slots: Callable        # batched read-only extract (peek)
+    reset_slots: Callable          # batched reset (EMIT CHANGES closes)
     extract_touched: Callable
     null_keys: tuple[str | None, ...]  # per agg: the __null_a{i} cols key
 
@@ -648,6 +770,9 @@ def compiled(spec: LatticeSpec, schema, filter_expr, max_out: int,
                                        layout, null_keys)),
         extract_slot=build_extract_slot(spec),
         reset_slot=build_reset_slot(spec),
+        extract_reset_slots=build_extract_reset_slots(spec),
+        extract_slots=build_extract_slots(spec),
+        reset_slots=build_reset_slots(spec),
         extract_touched=build_extract_touched(spec, max_out),
         null_keys=null_keys,
     )

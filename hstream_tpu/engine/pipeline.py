@@ -36,6 +36,8 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from hstream_tpu.common.columnar import extend_rows
+
 
 class IngestPipeline:
     """Pipelines stage_columnar (worker pool) with process_staged
@@ -141,14 +143,16 @@ class IngestPipeline:
         barrier)."""
         if self._dead or self._closed:
             raise RuntimeError("ingest pipeline worker has exited")
-        out: list[dict[str, Any]] = []
+        # rows accumulate via extend_rows so a lone columnar close
+        # batch (engine ColumnarEmit) reaches the sink unmaterialized
+        out: Any = None
         # backpressure: when the encoders are depth behind, block for one
         block = self._in.full()
         while True:
             rows = self._process_one(block)
             if rows is None:
                 break
-            out.extend(rows)
+            out = extend_rows(out, rows)
             block = False
         key_ids = np.asarray(key_ids)
         if len(key_ids) and self._ex.epoch is None:
@@ -175,20 +179,20 @@ class IngestPipeline:
                     # a stalled worker cannot deadlock the producer
                     rows = self._process_one(block=False)
                     if rows is not None:
-                        out.extend(rows)
-        return out
+                        out = extend_rows(out, rows)
+        return out if out is not None else []
 
     def flush(self) -> list[dict[str, Any]]:
         """Barrier: wait until every submitted batch is staged and
         processed; returns their emitted rows."""
         if self._dead:
             raise RuntimeError("ingest pipeline worker has exited")
-        out: list[dict[str, Any]] = []
+        out: Any = None
         while self.pending > 0:
             rows = self._process_one(block=True)
             if rows is not None:
-                out.extend(rows)
-        return out
+                out = extend_rows(out, rows)
+        return out if out is not None else []
 
     def stats(self) -> dict[str, float]:
         """Per-stage busy seconds + occupancy since construction.

@@ -55,8 +55,12 @@ class ShardedQueryExecutor(QueryExecutor):
             key_axis=self._key_axis)
         self._sharded = sharded
         self._step = sharded.step
-        self._extract_slot = sharded.extract_slot
-        self._reset_slot = sharded.reset_slot
+        self._extract_slot = self._count_close_kernel(sharded.extract_slot)
+        self._reset_slot = self._count_close_kernel(sharded.reset_slot)
+        self._extract_reset_slots = self._count_close_kernel(
+            sharded.extract_reset_slots)
+        self._extract_slots = sharded.extract_slots  # peek: read path
+        self._reset_slots = self._count_close_kernel(sharded.reset_slots)
         self._extract_touched = sharded.extract_touched
         self._null_specs = [
             (key, sorted(columns_of(agg.input)))

@@ -209,6 +209,66 @@ class ShardedLattice:
             in_specs=(spec_tree, P()),
             out_specs=spec_tree, check_vma=False))
 
+        # ---- fused multi-slot close (one dispatch per close cycle) ----
+        # Same contract as lattice.build_extract_reset_slots, with the
+        # monoid merge riding ICI (psum/pmin/pmax over `data`) BEFORE
+        # the single host fetch: slots i32[P] (entries < 0 pad), packed
+        # out [P, 2+rows, K] with the key axis concatenated over shards
+        # so kid indices in the buffer are GLOBAL key ids.
+
+        def _extract_slots_local(state, slots):
+            valid = slots >= 0
+            safe = jnp.where(valid, slots, 0)
+
+            def one(slot):
+                col = merged_col(state, slot)
+                outs = finalize_column(local_spec, col)
+                ws = jax.lax.pmax(state["slot_start"][0, slot], data_axis)
+                return lattice.pack_extract_rows(local_spec,
+                                                 col["count"], ws, outs)
+
+            packed = jax.vmap(one)(safe)
+            return jnp.where(valid[:, None, None], packed, 0)
+
+        def _reset_slots_local(state, slots):
+            rs = jnp.where(slots >= 0, slots, local_spec.n_slots)
+            out = dict(state)
+            for i, agg in enumerate(local_spec.aggs):
+                if agg.kind == lattice.AggKind.COUNT_ALL:
+                    continue  # aliases `count`, reset below
+                name = lattice._plane_name(i, agg)
+                out[name] = state[name].at[:, :, rs].set(
+                    init_value(agg), mode="drop")
+                if agg.kind == lattice.AggKind.AVG:
+                    out[name + "_n"] = state[name + "_n"].at[
+                        :, :, rs].set(0, mode="drop")
+            out["count"] = state["count"].at[:, :, rs].set(0, mode="drop")
+            out["touched"] = state["touched"].at[:, :, rs].set(
+                False, mode="drop")
+            out["slot_start"] = state["slot_start"].at[:, rs].set(
+                EMPTY_START, mode="drop")
+            return out
+
+        def extract_reset_local(state, slots):
+            packed = _extract_slots_local(state, slots)
+            return _reset_slots_local(state, slots), packed
+
+        self.extract_reset_slots = jax.jit(jax.shard_map(
+            extract_reset_local, mesh=mesh,
+            in_specs=(spec_tree, P()),
+            out_specs=(spec_tree, P(None, None, key_axis)),
+            check_vma=False))
+
+        self.extract_slots = jax.jit(jax.shard_map(
+            _extract_slots_local, mesh=mesh,
+            in_specs=(spec_tree, P()),
+            out_specs=P(None, None, key_axis), check_vma=False))
+
+        self.reset_slots = jax.jit(jax.shard_map(
+            _reset_slots_local, mesh=mesh,
+            in_specs=(spec_tree, P()),
+            out_specs=spec_tree, check_vma=False))
+
         max_out = self.max_out
 
         def touched_local(state):

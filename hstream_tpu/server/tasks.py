@@ -151,6 +151,20 @@ class QueryTask(threading.Thread):
             except Exception:  # noqa: BLE001
                 pass
 
+    def _note_decode(self, metric: str, logid: int, n: int) -> None:
+        """Count records through the native libjsondec batch decoder vs
+        the per-record Python fallback, per source stream — the /metrics
+        evidence that the JSON append path actually hits the native
+        decoder (server_json_eps regressions otherwise hide a silent
+        fallback)."""
+        stats = getattr(self.ctx, "stats", None)
+        if stats is None or n <= 0:
+            return
+        try:
+            stats.stream_stat_add(metric, self._sources[logid], n)
+        except Exception:  # noqa: BLE001 — metrics must not kill ingest
+            pass
+
     def source_streams(self) -> list[str]:
         names = [self.plan.source]
         if self.plan.join is not None:
@@ -557,6 +571,8 @@ class QueryTask(threading.Thread):
             return
         ts, cls, cols, nulls = decoded
         n = len(cls)
+        self._note_decode("json_decode_native", logid,
+                          int(np.sum(cls == jsondec.CLS_JSON)))
         i = 0
         while i < n:
             c = int(cls[i])
@@ -609,6 +625,8 @@ class QueryTask(threading.Thread):
                     continue  # raw records skipped (HStore.hs:119-143)
                 items.append(
                     ("row", d, r.header.publish_time_ms or default_ts))
+        self._note_decode("json_decode_fallback", logid,
+                          sum(1 for k, _v, _t in items if k == "row"))
         for kind, val, t in items:
             if kind == "col":
                 flush_rows()
@@ -1027,10 +1045,13 @@ def stream_sink(ctx, sink_stream: str,
 
     def sink(rows: list[dict[str, Any]]) -> None:
         payloads = None
-        if len(rows) >= 32:
+        if isinstance(rows, columnar.ColumnarEmit) or len(rows) >= 32:
             # steady-state batches of homogeneous flat rows go out as
             # ONE columnar record — per-row protobuf Struct building is
-            # the emit stage's entire cost at changelog rates
+            # the emit stage's entire cost at changelog rates. A
+            # ColumnarEmit close batch encodes straight from its
+            # columns, so the emitted rows never materialize as dicts
+            # on this path at ANY batch size.
             packed = columnar.rows_to_payload(rows, rec.now_ms())
             if packed is not None:
                 payloads = [rec.build_record(packed).SerializeToString()]

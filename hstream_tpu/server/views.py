@@ -53,6 +53,11 @@ class Materialization:
                 tuple(row.get(c) for c in self._group_cols))
 
     def add_closed(self, rows: list[dict[str, Any]]) -> None:
+        # `rows` may be a columnar close batch (common.columnar
+        # ColumnarEmit): the view store is a row-shaped boundary (pull
+        # queries serve dicts), so iterating materializes the row view
+        # once — cached on the batch, shared with any other row-shaped
+        # consumer of the same emission.
         with self._lock:
             for row in rows:
                 key = self._row_key(row)

@@ -30,6 +30,9 @@ PER_STREAM_COUNTERS = [
     "delivery_credit_waits",   # push deliveries paused at zero credit
     "record_payload_bytes",    # bytes read out by consumers/queries
     "record_total",            # records read
+    "json_decode_native",      # JSON records through libjsondec batch dec
+    "json_decode_fallback",    # JSON records through the Python per-record
+                               # decode (no toolchain, or CLS_PY rows)
 ]
 
 PER_STREAM_TIME_SERIES = [

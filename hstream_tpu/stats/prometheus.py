@@ -39,6 +39,10 @@ _HELP = {
     "delivery_credit_waits": "push deliveries paused at zero credit",
     "record_payload_bytes": "bytes read out by consumers/queries",
     "record_total": "records read",
+    "json_decode_native": "JSON records decoded by the native batch "
+                          "decoder (libjsondec)",
+    "json_decode_fallback": "JSON records decoded by the per-record "
+                            "Python fallback",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",
