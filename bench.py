@@ -289,7 +289,13 @@ def bench_config4_session_quantile() -> dict:
 
 def bench_config5_join_view() -> dict:
     """BASELINE config 5: stream-stream interval JOIN + GROUP BY into a
-    materialized view (host two-sided state + device aggregation)."""
+    materialized view — the DEVICE-RESIDENT join path: per-side device
+    stores, ONE fused probe+insert+aggregate dispatch per micro-batch
+    (matches scatter straight into the downstream lattice — zero
+    per-batch D2H), columnar changelog decode on the deferred extract
+    drains. Batches are pre-generated COLUMNAR (the server's join
+    ingest shape), so the timed region measures the engine, not dict
+    building."""
     from hstream_tpu.sql.codegen import make_executor, stream_codegen
 
     plan = stream_codegen(
@@ -300,27 +306,36 @@ def bench_config5_join_view() -> dict:
     ex = make_executor(plan, sample_rows=[{"k": "k0", "x": 1.0}],
                        batch_capacity=1 << 15)
     rng = np.random.default_rng(5)
-    n, batches = 2048, 20
+    n, batches = 8192, 20
+    n_keys = 4000  # scaled with n so matches-per-record (~4) stays at
+                   # the old 2048-row config's amplification
     base = 1_700_000_000_000
+    keys = np.array([f"k{i}" for i in range(n_keys)], object)
+    # pre-generated columnar batches (keys cycle, ts regenerated per
+    # use so stream time advances)
+    kcols = [keys[rng.integers(0, n_keys, n)] for _ in range(8)]
+    xcol = np.ones(n, np.float32)
 
     def mk(b):
-        return ([{"k": f"k{int(i)}", "x": 1.0}
-                 for i in rng.integers(0, 1000, n)],
-                [base + b * 500 + i % 500 for i in range(n)])
+        ts = base + b * 500 + np.sort(rng.integers(0, 500, n)) \
+            .astype(np.int64)
+        return kcols[b % 8], ts
 
     joined = 0
     warm = 14
     # pipeline the changelog fetches behind later batches' host work,
     # fetch them in batched async device->host transfers (the knobs
-    # proxy through the join onto its downstream aggregate), and
-    # coalesce probe matches so each device step (a round trip) covers
-    # many input batches
+    # proxy through the join onto its downstream aggregate), defer +
+    # stack the probe match fetches the same way, and coalesce matches
+    # so each inner step (a round trip) covers many input batches
     ex.defer_change_decode = True
     ex.change_drain_depth = 8
     ex.async_change_drain = True
+    ex.match_drain_depth = 8
     for b in range(warm):  # warmup/compile (incl. coalesced step shapes)
-        rows, ts = mk(b)
-        ex.process(rows, ts, stream="l" if b % 2 else "r")
+        kk, ts = mk(b)
+        ex.process_columnar(ts, {"k": kk, "x": xcol},
+                            stream="l" if b % 2 else "r")
         if b == 1:
             ex.coalesce_rows = 1 << 15
     ex.flush_changes()
@@ -331,19 +346,92 @@ def bench_config5_join_view() -> dict:
     b0 = warm
     for _rep in range(2):
         joined = 0
+        probe_ms: list[float] = []
+        stats0 = dict(getattr(ex, "join_stats", {}))
         t0 = time.perf_counter()
         for b in range(b0, batches + b0):
-            rows, ts = mk(b)
-            out = ex.process(rows, ts, stream="l" if b % 2 else "r")
+            kk, ts = mk(b)
+            t1 = time.perf_counter()
+            out = ex.process_columnar(ts, {"k": kk, "x": xcol},
+                                      stream="l" if b % 2 else "r")
+            probe_ms.append((time.perf_counter() - t1) * 1e3)
             joined += len(out)
         joined += len(ex.flush_changes())  # staged matches + changes
         dt = time.perf_counter() - t0
         b0 += batches
-        res = {"events_per_sec": round(batches * n / dt),
-               "change_rows_per_sec": round(joined / dt)}
+        js = getattr(ex, "join_stats", {})
+        d_batches = js.get("probe_batches", 0) - stats0.get(
+            "probe_batches", 0)
+        d_disp = js.get("probe_dispatches", 0) - stats0.get(
+            "probe_dispatches", 0)
+        res = {
+            "events_per_sec": round(batches * n / dt),
+            "change_rows_per_sec": round(joined / dt),
+            # fused-probe contract: ONE device dispatch per join
+            # micro-batch (>1.0 = overflow redos or a fusion break)
+            "probe_dispatches_per_batch": round(
+                d_disp / max(d_batches, 1), 3),
+            "p50_probe_dispatch_ms": round(
+                float(np.percentile(probe_ms, 50)), 3),
+            "p99_probe_dispatch_ms": round(
+                float(np.percentile(probe_ms, 99)), 3),
+        }
         if best is None or res["events_per_sec"] > best["events_per_sec"]:
             best = res
+    best["join_stats"] = dict(getattr(ex, "join_stats", {}))
+    best.update(bench_changelog_decode())
     return best
+
+
+def bench_changelog_decode() -> dict:
+    """Dedicated changelog-decode throughput: time the batched columnar
+    decode (unpack_touched_rows -> key reverse-index gather ->
+    ColumnarEmit) of one touched extract against the retained per-row
+    reference — rows/s, engine-side only (no device in the loop)."""
+    from hstream_tpu.engine import (
+        AggKind, AggSpec, AggregateNode, ColumnType, QueryExecutor,
+        Schema, SourceNode, TumblingWindow,
+    )
+    from hstream_tpu.engine.expr import Col
+
+    schema = Schema.of(device=ColumnType.STRING, temp=ColumnType.FLOAT)
+    node = AggregateNode(
+        child=SourceNode("s", schema), group_keys=[Col("device")],
+        window=TumblingWindow(10_000, grace_ms=0),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "c"),
+              AggSpec(AggKind.SUM, "t", input=Col("temp"))])
+    ex = QueryExecutor(node, schema, emit_changes=True,
+                       initial_keys=4096, batch_capacity=1 << 15)
+    ex.defer_change_decode = True
+    rng = np.random.default_rng(9)
+    n_keys = 4000
+    for k in range(n_keys):
+        ex.key_id_for((f"d{k}",))
+    kids = rng.integers(0, n_keys, 1 << 14).astype(np.int32)
+    temps = rng.normal(20, 5, 1 << 14).astype(np.float32)
+    ts = 1_700_000_000_000 + np.arange(1 << 14, dtype=np.int64) % 200
+    ex.process_columnar(kids, ts, {"temp": temps})
+    epoch, buf = ex._pending_changes[0]
+    pk = np.asarray(buf)
+    rows = len(ex._decode_changes_rows(pk, epoch))
+    reps = 20
+    from hstream_tpu.common import columnar as _col
+
+    # force all the way to the wire record: ColumnarEmit.to_payload
+    # encodes straight from the columns, the per-row reference pays
+    # dict rows + the row-wise payload scan — the two real sink paths
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _col.rows_to_payload(ex._decode_changes(pk, epoch), 0)
+    col_dt = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _col.rows_to_payload(ex._decode_changes_rows(pk, epoch), 0)
+    row_dt = (time.perf_counter() - t0) / reps
+    return {
+        "change_decode_rows_per_sec": round(rows / col_dt),
+        "change_decode_rows_per_sec_perrow_ref": round(rows / row_dt),
+    }
 
 
 def bench_store_append(tmpdir: str) -> dict:
@@ -479,7 +567,11 @@ def server_path_eps() -> dict:
     from hstream_tpu.server.main import serve
 
     server, ctx = serve("127.0.0.1", 0, "mem://")
-    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    # fetch responses expand columnar records per-row: raise the
+    # client-side receive cap to the server's send cap
+    ch = grpc.insecure_channel(
+        f"127.0.0.1:{ctx.port}",
+        options=[("grpc.max_receive_message_length", 64 * 1024 * 1024)])
     stub = HStreamApiStub(ch)
     out: dict[str, float] = {}
     try:
@@ -559,8 +651,12 @@ def server_path_eps() -> dict:
         stub.CreateSubscription(pb.Subscription(
             subscription_id="bench-sub", stream_name="bsrc"))
         for _ in range(50):
+            # max_size counts store BATCHES and the subscription
+            # expands columnar records per-row at the wire, so one
+            # 256k-row batch is already ~16MB of response — larger
+            # windows blow the 64MB gRPC message cap
             stub.Fetch(pb.FetchRequest(subscription_id="bench-sub",
-                                       timeout_ms=10, max_size=64))
+                                       timeout_ms=10, max_size=1))
 
         # RPC latency percentiles from the server's fixed-bucket
         # histograms + the running task's stage occupancy: the
@@ -760,5 +856,35 @@ def main() -> None:
     pipe.close()
 
 
+def loopback_main() -> None:
+    """`python bench.py --loopback`: server-path bench with the device
+    link OUT of the measurement — JAX pinned to the local CPU backend
+    before any jax import, so the number isolates the server path
+    (protobuf decode, RPC, pipeline) from the tunneled dev chip whose
+    bandwidth swings >10x minute-to-minute. Use this mode to guard
+    server-path regressions; the accelerator-path numbers stay in the
+    default mode."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    result = {
+        "metric": "server_loopback_eps",
+        "unit": "events/s",
+        "mode": "loopback",
+        "platform": jax.devices()[0].platform,
+    }
+    sp = server_path_eps()
+    result.update(sp)
+    result["value"] = sp.get("server_columnar_eps")
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--loopback" in sys.argv[1:]:
+        loopback_main()
+    else:
+        main()

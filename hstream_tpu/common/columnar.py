@@ -228,6 +228,16 @@ class ColumnarEmit(Sequence):
     def __iter__(self):
         return iter(self.rows())
 
+    # list-concat ergonomics: emitted batches historically were plain
+    # lists, so `acc += ex.process(...)` and `rows + more` must keep
+    # working when either side is a columnar batch (materializes —
+    # callers that care use extend_rows to stay columnar)
+    def __add__(self, other):
+        return self.rows() + list(other)
+
+    def __radd__(self, other):
+        return list(other) + self.rows()
+
     def __repr__(self) -> str:
         return (f"ColumnarEmit(n={self.n}, "
                 f"cols={list(self.cols)})")

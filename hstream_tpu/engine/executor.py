@@ -565,7 +565,7 @@ class QueryExecutor:
                        null_streams, wm_rel)
 
         # host window bookkeeping
-        out: list[dict[str, Any]] = []
+        out = None
         if self.window is not None:
             self._track_windows(np.asarray(ts_ms, dtype=np.int64),
                                 batch_starts)
@@ -574,9 +574,11 @@ class QueryExecutor:
             self.watermark_abs = new_wm
 
         if self.emit_changes:
-            out.extend(self._drain_changes())
-        # a lone closed batch stays columnar all the way to the caller
-        return extend_rows(out, self.close_due_windows()) or out
+            out = extend_rows(out, self._drain_changes())
+        # a lone columnar batch (changes or closes) stays columnar all
+        # the way to the caller
+        out = extend_rows(out, self.close_due_windows())
+        return out if out is not None else []
 
     def _track_windows(self, ts_abs: np.ndarray,
                        starts: set[int] | None = None) -> None:
@@ -684,14 +686,15 @@ class QueryExecutor:
         self._run_step(cap, n, key_ids, ts_rel64, cols, valid,
                        null_streams, wm_rel)
 
-        out: list[dict[str, Any]] = []
+        out = None
         if self.window is not None:
             self._track_windows(ts_list, batch_starts)
         if max_ts > self.watermark_abs:
             self.watermark_abs = max_ts
         if self.emit_changes:
-            out.extend(self._drain_changes())
-        return extend_rows(out, self.close_due_windows()) or out
+            out = extend_rows(out, self._drain_changes())
+        out = extend_rows(out, self.close_due_windows())
+        return out if out is not None else []
 
     # ---- pipelined ingest (stage on one thread, step on another) ----------
 
@@ -821,14 +824,15 @@ class QueryExecutor:
         self.state = step(self.state, wm_rel, np.int32(staged.n),
                           staged.bases, staged.words)
 
-        out: list[dict[str, Any]] = []
+        out = None
         if self.window is not None:
             self._track_windows(ts_list, batch_starts)
         if staged.ts_max > self.watermark_abs:
             self.watermark_abs = staged.ts_max
         if self.emit_changes:
-            out.extend(self._drain_changes())
-        return extend_rows(out, self.close_due_windows()) or out
+            out = extend_rows(out, self._drain_changes())
+        out = extend_rows(out, self.close_due_windows())
+        return out if out is not None else []
 
     def key_id_for(self, key: tuple) -> int:
         """Dense id for a group-key tuple (columnar-path key dictionary).
@@ -884,7 +888,7 @@ class QueryExecutor:
             row["winEnd"] = win_start_abs + self.window.size_ms
         return self._postprocess(row)
 
-    def _drain_changes(self) -> list[dict[str, Any]]:
+    def _drain_changes(self) -> "ColumnarEmit | list[dict[str, Any]]":
         self.state, packed = self._extract_touched(self.state)
         if not self.defer_change_decode:
             return self._decode_changes(np.asarray(packed), self.epoch)
@@ -893,21 +897,22 @@ class QueryExecutor:
         self._pending_changes.append((self.epoch, packed))
         out = self._collect_drained(block=False)
         if len(self._pending_changes) <= max(self.change_drain_depth, 1):
-            return out
+            return out if out is not None else []
         # keep the newest extract deferred (it pipelines behind the
         # next batch's work); fetch everything older in one transfer
         keep = self._pending_changes.pop()
         batch = self._pending_changes
         self._pending_changes = [keep]
         if self.async_change_drain:
-            # the blocking D2H fetch + row decode move to the shared
-            # drain pool; rows surface on later calls, in FIFO order
+            # the blocking D2H fetch + columnar decode move to the
+            # shared drain pool; batches surface on later calls, in
+            # FIFO order
             self._drain_futs.append(
                 _change_drain_pool().submit(self._drain_job, batch))
-            out.extend(self._collect_drained(block=False))
+            out = extend_rows(out, self._collect_drained(block=False))
         else:
-            out.extend(self._decode_pending(batch))
-        return out
+            out = extend_rows(out, self._decode_pending(batch))
+        return out if out is not None else []
 
     def _drain_job(self, batch: list) -> list[dict[str, Any]]:
         """One async drain unit (drain-pool thread). Reads only
@@ -921,54 +926,94 @@ class QueryExecutor:
             with self._stats_lock:
                 self.stage_stats["drain_s"] += time.perf_counter() - t0
 
-    def _collect_drained(self, block: bool) -> list[dict[str, Any]]:
+    def _collect_drained(self, block: bool):
         """Completed async drains, strictly in submission order (head
         pop only — a done future behind an unfinished one waits, so
-        change rows never reorder). block=True takes everything."""
-        rows: list[dict[str, Any]] = []
+        change rows never reorder). block=True takes everything. A lone
+        columnar batch rides through unmaterialized (extend_rows)."""
+        rows = None
         while self._drain_futs:
             f = self._drain_futs[0]
             if not block and not f.done():
                 break
             self._drain_futs.popleft()
-            rows.extend(f.result())
+            rows = extend_rows(rows, f.result())
         return rows
 
     def flush_changes(self) -> list[dict[str, Any]]:
         """Decode every deferred changelog extract (forces the async
         drain queue, then the still-pending tail)."""
-        rows = self._collect_drained(block=True)
-        rows.extend(self._decode_pending(self._pending_changes))
+        rows = extend_rows(self._collect_drained(block=True),
+                           self._decode_pending(self._pending_changes))
         self._pending_changes = []
-        return rows
+        return rows if rows is not None else []
 
     def has_pending_changes(self) -> bool:
         """True when deferred change extracts (queued or in the async
         drain) still hold undelivered rows."""
         return bool(self._pending_changes or self._drain_futs)
 
-    def _decode_pending(self, pending: list) -> list[dict[str, Any]]:
+    def _decode_pending(self, pending: list
+                        ) -> "ColumnarEmit | list[dict[str, Any]]":
         """Decode deferred change extracts, fetching device buffers in
         ONE device->host transfer per buffer shape (fetch count, not
         bytes, dominates on real links — each np.asarray is a full
-        round trip). Shapes differ only across grow_keys boundaries."""
+        round trip). Shapes differ only across grow_keys boundaries.
+        A single extract's batch stays columnar (ColumnarEmit)."""
         if not pending:
             return []
         if len(pending) == 1:
             epoch, buf = pending[0]
             return self._decode_changes(np.asarray(buf), epoch)
-        rows: list[dict[str, Any]] = []
+        rows = None
         by_shape: dict[tuple, list] = {}
         for ep, buf in pending:
             by_shape.setdefault(tuple(buf.shape), []).append((ep, buf))
         for group in by_shape.values():
             stacked = np.asarray(jnp.stack([b for _, b in group]))
             for (ep, _), buf in zip(group, stacked):
-                rows.extend(self._decode_changes(buf, ep))
-        return rows
+                rows = extend_rows(rows, self._decode_changes(buf, ep))
+        return rows if rows is not None else []
 
-    def _decode_changes(self, packed: np.ndarray,
-                        epoch: int | None) -> list[dict[str, Any]]:
+    def _decode_changes(self, packed: np.ndarray, epoch: int | None
+                        ) -> "ColumnarEmit | list[dict[str, Any]]":
+        """Batched changelog decode: unpack the touched extract, gather
+        group-key columns through the cached reverse index, finalize
+        aggregate columns, and hand the whole batch to the columnar
+        HAVING/projection pass — a ColumnarEmit, no per-row walk (the
+        changelog twin of _decode_extract_batch). The retained per-row
+        reference is _decode_changes_rows (equivalence tests)."""
+        n, kidx, win_start_rel, outs = lattice.unpack_touched_rows(
+            self.spec, packed)
+        if n == 0:
+            return []
+        cols: dict[str, Any] = {}
+        kidx = kidx.astype(np.int64)
+        for name, arr in zip(self.group_cols, self._key_rev_columns()):
+            cols[name] = arr[kidx]
+        for agg in self.spec.aggs:
+            v = outs[agg.out_name]
+            if agg.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                finite = np.isfinite(v)
+                vals = np.empty(len(v), object)
+                vals[:] = [[float(x) for x in row[m]]
+                           for row, m in zip(v, finite)]
+                cols[agg.out_name] = vals
+            elif agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT,
+                              AggKind.APPROX_COUNT_DISTINCT):
+                cols[agg.out_name] = np.rint(v).astype(np.int64)
+            else:
+                cols[agg.out_name] = v.astype(np.float64)
+        if self.window is not None:
+            ws = win_start_rel.astype(np.int64) + epoch
+            cols["winStart"] = ws
+            cols["winEnd"] = ws + self.window.size_ms
+        return self._postprocess_cols(cols, n)
+
+    def _decode_changes_rows(self, packed: np.ndarray,
+                             epoch: int | None) -> list[dict[str, Any]]:
+        """Per-row changelog decode (the pre-columnar reference path,
+        kept for equivalence tests)."""
         n, kidx, win_start_rel, outs_np = lattice.unpack_touched_rows(
             self.spec, packed)
         rows = []

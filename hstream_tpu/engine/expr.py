@@ -230,13 +230,17 @@ _HOST_UNARY: dict[str, Callable[[Any], Any]] = {
 def eval_host_vec(expr: Expr, cols: Mapping[str, Any]) -> Any:
     """Columnwise twin of eval_host over numpy arrays: evaluates HAVING
     and SELECT projections for a whole emitted batch in one pass instead
-    of one interpreter walk per row (the window-close emission path).
+    of one interpreter walk per row (the window-close and changelog
+    emission paths).
 
-    Covers the numeric/boolean/comparison core plus NEG/NOT and the
-    numeric unaries; ops outside that set (string/array builtins,
-    IFNULL) raise SQLCodegenError so the caller falls back to the
-    per-row interpreter — semantics stay identical, only the common
-    case is vectorized."""
+    The numeric/boolean/comparison core and the numeric unaries map to
+    native numpy ufuncs; every remaining scalar op from the host
+    interpreter — string builtins, type predicates, array ops, IFNULL —
+    evaluates through a frompyfunc broadcast of the SAME host function,
+    so joined projections over string/array columns stay columnar with
+    semantics identical to the per-row interpreter. Only NULL literals
+    (and genuinely unknown ops) still raise SQLCodegenError for the
+    per-row fallback."""
     import numpy as np
 
     if isinstance(expr, Col):
@@ -253,6 +257,19 @@ def eval_host_vec(expr: Expr, cols: Mapping[str, Any]) -> Any:
         return expr.value
     if isinstance(expr, BinOp):
         op = expr.op
+        if op == "IFNULL":
+            l = eval_host_vec(expr.left, cols)
+            r = eval_host_vec(expr.right, cols)
+            if np.ndim(l) == 0:
+                return r if l is None else l
+            la = np.asarray(l)
+            if la.dtype != object:
+                return la  # typed arrays cannot hold SQL NULLs
+            mask = np.frompyfunc(lambda x: x is None, 1, 1)(
+                la).astype(bool)
+            if not mask.any():
+                return la
+            return np.where(mask, r, la)
         l = eval_host_vec(expr.left, cols)
         r = eval_host_vec(expr.right, cols)
         if op == "AND":
@@ -281,6 +298,12 @@ def eval_host_vec(expr: Expr, cols: Mapping[str, Any]) -> Any:
             return l > r
         if op == ">=":
             return l >= r
+        if op == "ARR_CONTAINS":
+            return np.frompyfunc(lambda a, b: b in a, 2, 1)(
+                l, r).astype(bool)
+        if op == "ARR_JOIN":
+            return np.frompyfunc(
+                lambda a, b: str(b).join(str(x) for x in a), 2, 1)(l, r)
         raise SQLCodegenError(f"op {op}: per-row fallback")
     if isinstance(expr, UnOp):
         op = expr.op
@@ -297,9 +320,21 @@ def eval_host_vec(expr: Expr, cols: Mapping[str, Any]) -> Any:
                "ASINH": np.arcsinh, "ACOSH": np.arccosh,
                "ATANH": np.arctanh, "LOG": np.log, "LOG2": np.log2,
                "LOG10": np.log10, "EXP": np.exp}.get(op)
-        if vec is None:
+        if vec is not None:
+            arr = np.asarray(v)
+            if arr.dtype != object:
+                return vec(arr)
+            # object column (e.g. ints mixed with NULL-bearing rows):
+            # broadcast the exact host scalar through frompyfunc
+        host_fn = _HOST_UNARY.get(op)
+        if host_fn is None:
             raise SQLCodegenError(f"op {op}: per-row fallback")
-        return vec(np.asarray(v))
+        if np.ndim(v) == 0:
+            return host_fn(v)
+        out = np.frompyfunc(host_fn, 1, 1)(np.asarray(v, object))
+        if op.startswith("IS_"):
+            return out.astype(bool)
+        return out
     raise SQLCodegenError(f"unknown expr {expr!r}")
 
 

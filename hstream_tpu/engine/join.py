@@ -10,11 +10,25 @@ is max(ts1, ts2). The joined stream feeds the rest of the plan
 (filter -> window aggregate -> ...), exactly like the reference's
 merged-stream task DAG (Codegen.hs:253-266).
 
-Design: the join itself is host-side two-sided state (per-key sorted
-ts lists — the same per-record KV walk the reference does), while the
-downstream aggregation still runs as the jitted device lattice. Join
-state is pruned by within + downstream grace, bounding memory where the
-reference's in-memory store grows forever.
+Design: two execution paths with identical semantics.
+
+  * Device path (the hot one): both sides live as device-resident
+    sorted stores (engine.lattice interval-join kernels) and every
+    micro-batch is ONE fused probe+insert dispatch plus ONE
+    device->host fetch of the packed match buffer; watermark eviction
+    is a vmapped two-sided compaction kernel and the int32 relative
+    time space rebases on the shared join epoch instead of aborting.
+    Matched pairs feed the inner aggregate columnar (optionally
+    coalesced across micro-batches), so no joined-row dicts ever
+    materialize. Activated once the columnar fast path is planned
+    (`_plan_fast`); `use_device_join=False` forces the host path.
+  * Host path (the equivalence reference): `_FlatIntervalStore` per
+    side — flat sorted arrays probed with one searchsorted pair per
+    batch, the batch restatement of the reference's per-record ordered
+    map walk. Also serves plans the fast path cannot columnarize.
+
+Join state is pruned by within + downstream grace, bounding memory
+where the reference's in-memory store grows forever.
 """
 
 from __future__ import annotations
@@ -28,8 +42,10 @@ from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
 from hstream_tpu.engine.statestore import LastValueStore
-from hstream_tpu.engine.types import canon_key
+from hstream_tpu.engine.types import canon_key, round_up_pow2
 from hstream_tpu.engine.window import DEFAULT_GRACE_MS
+
+_MISS = object()  # row.get sentinel: "field absent", distinct from None
 
 
 def split_on_condition(on: Expr, left_streams: set[str],
@@ -234,16 +250,21 @@ class _JoinBase:
     def flush_changes(self) -> list[dict[str, Any]]:
         """Deliver every lagging emission: coalesced match rows staged
         for the inner step first, then the inner executor's deferred
-        changelog extracts — the same barrier QueryExecutor exposes."""
+        changelog extracts — the same barrier QueryExecutor exposes.
+        A lone columnar change batch rides through unmaterialized."""
+        from hstream_tpu.common.columnar import extend_rows
+
         rows = (self.flush_staged()
                 if hasattr(self, "flush_staged") else [])
         inner = self._inner
         if inner is not None and hasattr(inner, "flush_changes"):
-            rows.extend(inner.flush_changes())
-        return rows
+            rows = extend_rows(rows, inner.flush_changes())
+        return rows if rows is not None else []
 
     def has_pending_changes(self) -> bool:
         if getattr(self, "_staged_n", 0):
+            return True
+        if getattr(self, "_pending_matches", None):
             return True
         inner = self._inner
         if inner is None:
@@ -429,6 +450,10 @@ class JoinExecutor(_JoinBase):
     inner (aggregate/stateless) executor built over the joined schema.
     """
 
+    # the task runtime may feed columnar batches straight through
+    # process_columnar (no row materialization on the server path)
+    supports_columnar_join = True
+
     def __init__(self, plan, *, initial_keys: int = 1024,
                  batch_capacity: int = 4096):
         super().__init__(plan, initial_keys=initial_keys,
@@ -463,6 +488,28 @@ class JoinExecutor(_JoinBase):
         self.coalesce_rows = 0
         self._staged: list[tuple] = []   # (key_ids, jts, cols, nulls)
         self._staged_n = 0
+        # Device-resident join: once the columnar fast path is planned,
+        # both sides migrate into device stores and each micro-batch
+        # becomes ONE fused probe+insert dispatch + ONE fetch of the
+        # packed match buffer (engine.lattice interval-join kernels).
+        # use_device_join=False pins the host reference path.
+        self.use_device_join = True
+        self._dev: dict | None = None
+        # >1 defers match-buffer fetches: buffers stack into one
+        # batched D2H transfer every `depth` micro-batches, so the
+        # round trip amortizes (emission then lags; flush_staged is
+        # the barrier). The fused close's deferred-fetch idiom.
+        self.match_drain_depth = 1
+        self._pending_matches: list[tuple] = []
+        # probe-path dispatch accounting: the device-join contract is
+        # ONE probe dispatch per micro-batch (and fetches <= batches);
+        # tests and bench assert probe_dispatches == probe_batches
+        self.join_stats = {
+            "probe_batches": 0, "probe_dispatches": 0,
+            "probe_fetches": 0, "match_redispatches": 0,
+            "evict_dispatches": 0, "rebase_dispatches": 0,
+            "store_grows": 0, "fused_batches": 0,
+        }
 
     # ---- ingest ------------------------------------------------------------
     #
@@ -495,46 +542,101 @@ class JoinExecutor(_JoinBase):
                 kidx = np.nonzero(keep)[0]
                 codes = codes[kidx]
                 bts = ts[kidx]
-                brows = np.asarray([dict(rows[i]) for i in kidx.tolist()],
-                                   object)
             else:
+                kidx = None
                 bts = ts
-                brows = np.empty(n, object)
-                for i, r in enumerate(rows):
-                    brows[i] = dict(r)
             if len(codes):
                 order = np.lexsort((bts, codes))
                 codes = codes[order]
                 bts = bts[order]
-                brows = brows[order]
-                # probe the other side BEFORE inserting: the reference
-                # loop probes only the opposite store, which this batch
-                # never mutates, so insert/probe need no interleaving
-                pr = other.probe(codes, bts - self.within,
-                                 bts + self.within)
-                mine.insert_sorted(codes, bts, brows)
-                if pr is not None:
-                    lo_i, hi_i = pr
-                    cnt = hi_i - lo_i
-                    tot = int(cnt.sum())
-                    if tot:
-                        start = np.cumsum(cnt) - cnt
-                        oidx = (np.arange(tot, dtype=np.int64)
-                                - np.repeat(start, cnt)
-                                + np.repeat(lo_i, cnt))
-                        rep = np.repeat(np.arange(len(codes)), cnt)
-                        jts = np.maximum(bts[rep], other.ts[oidx])
-                        out = self._emit_matches(
-                            side, brows, rep, codes[rep], other, oidx,
-                            jts)
-        new_wm = max((int(t) for t in ts_ms), default=self.watermark)
-        if new_wm > self.watermark:
-            self.watermark = new_wm
-            cutoff = self.watermark - self.retention_ms
-            if cutoff > 0:
-                mine.prune(cutoff)
-                other.prune(cutoff)
+                ridx = order if kidx is None else kidx[order]
+                if self._device_ready():
+                    lay = self._dev["lay"][side]
+                    flags, vals = self._encode_join_cols(
+                        lay, [rows[j] for j in ridx.tolist()])
+                    out = self._device_batch(side, codes, bts, flags,
+                                             vals)
+                else:
+                    out = self._host_batch(side, mine, other, codes,
+                                           bts, rows, ridx)
+        self._advance_watermark(max((int(t) for t in ts_ms),
+                                    default=self.watermark))
         return out
+
+    def process_columnar(self, ts_ms, cols: Mapping[str, np.ndarray],
+                         nulls: Mapping[str, np.ndarray] | None = None,
+                         *, stream: str | None = None
+                         ) -> list[dict[str, Any]]:
+        """Columnar twin of process(): int64 absolute-ms timestamps plus
+        named numpy columns (str/object arrays for strings; a null-mask
+        cell means the field is ABSENT from that record, like the
+        per-record decode's dropped keys). On the device path the batch
+        packs straight from the columns — vectorized key encode, no
+        per-row Python at all; until the device path activates (or on
+        the host reference path) rows materialize once and take the row
+        path, so semantics are identical."""
+        n = len(ts_ms)
+        if n == 0:
+            return []
+        side = self._side_of(stream)
+        self._fields[side].update(cols.keys())
+        ts = np.asarray(ts_ms, np.int64)
+        out: list[dict[str, Any]] = []
+        enc = None
+        if self._device_ready():
+            my_keys = (self.left_keys if side == "l"
+                       else self.right_keys)
+            enc = self._columnar_batch(side, my_keys, ts, cols, nulls)
+        if enc is not None:
+            codes, bts, flags, vals = enc
+            if len(codes):
+                out = self._device_batch(side, codes, bts, flags, vals)
+            self._advance_watermark(int(ts.max()))
+            return out
+        # fallback: materialize rows once (pre-activation, non-Col ON
+        # keys, or untyped columns) and run the row path
+        return self.process(self._rows_from_cols(cols, nulls, n),
+                            ts.tolist(), stream=stream)
+
+    def _advance_watermark(self, new_wm: int) -> None:
+        if new_wm <= self.watermark:
+            return
+        self.watermark = new_wm
+        cutoff = self.watermark - self.retention_ms
+        if cutoff > 0:
+            if self._dev is not None:
+                self._maybe_evict(cutoff)
+            else:
+                self._stores["l"].prune(cutoff)
+                self._stores["r"].prune(cutoff)
+
+    def _host_batch(self, side, mine, other, codes, bts, rows,
+                    ridx) -> list[dict[str, Any]]:
+        """The host reference path: batch searchsorted probe over the
+        flat sorted stores (see _FlatIntervalStore)."""
+        brows = np.empty(len(ridx), object)
+        for i, j in enumerate(ridx.tolist()):
+            brows[i] = dict(rows[j])
+        # probe the other side BEFORE inserting: the reference
+        # loop probes only the opposite store, which this batch
+        # never mutates, so insert/probe need no interleaving
+        pr = other.probe(codes, bts - self.within, bts + self.within)
+        mine.insert_sorted(codes, bts, brows)
+        if pr is None:
+            return []
+        lo_i, hi_i = pr
+        cnt = hi_i - lo_i
+        tot = int(cnt.sum())
+        if not tot:
+            return []
+        start = np.cumsum(cnt) - cnt
+        oidx = (np.arange(tot, dtype=np.int64)
+                - np.repeat(start, cnt)
+                + np.repeat(lo_i, cnt))
+        rep = np.repeat(np.arange(len(codes)), cnt)
+        jts = np.maximum(bts[rep], other.ts[oidx])
+        return self._emit_matches(side, brows, rep, codes[rep], other,
+                                  oidx, jts)
 
     def _batch_codes(self, my_keys, rows) -> np.ndarray:
         """Dense join-key code per row (-1 = null key, skipped). One
@@ -583,11 +685,23 @@ class JoinExecutor(_JoinBase):
         """Code-space compaction: keep only codes still live in either
         store (retention bounds them), reassign dense codes in sorted
         order (store order is preserved), remap stores + lut + dict."""
-        live = np.union1d(self._stores["l"].code, self._stores["r"].code)
+        parts = [self._stores["l"].code, self._stores["r"].code]
+        if self._dev is not None:
+            self._refresh_counts()
+            for s in ("l", "r"):
+                n = self._dev["n"][s]
+                if n:
+                    parts.append(np.asarray(
+                        self._dev["stores"][s]["code"])[:n]
+                        .astype(np.int64))
+        live = np.union1d(parts[0], np.concatenate(parts[1:])
+                          if len(parts) > 1 else parts[0])
         new_of_old = np.full(len(self._jcode_rev), -1, np.int64)
         new_of_old[live] = np.arange(len(live))
         for st in self._stores.values():
             st.remap_codes(new_of_old)
+        if self._dev is not None:
+            self._remap_device_codes(new_of_old)
         new_rev = [self._jcode_rev[int(c)] for c in live.tolist()]
         self._jcode.clear()
         self._jcode.update({k: i for i, k in enumerate(new_rev)})
@@ -601,6 +715,25 @@ class JoinExecutor(_JoinBase):
 
     # ---- match emission ----------------------------------------------------
 
+    def _feed_inner_columnar(self, key_ids, jts, cols, nulls
+                             ) -> list[dict[str, Any]]:
+        """Step (or coalesce-stage) one columnar match batch into the
+        inner executor — shared by the host and device probe paths.
+        The joined stream's watermark is the JOIN's watermark (both
+        probe paths forward it before stepping matches, so the fused
+        device kernel and this host feed apply the same late mask)."""
+        inner = self._inner
+        if (getattr(inner, "watermark_abs", None) is not None
+                and self.watermark > inner.watermark_abs):
+            inner.watermark_abs = self.watermark
+        if self.coalesce_rows > 0:
+            self._staged.append((key_ids, jts, cols, nulls))
+            self._staged_n += len(key_ids)
+            if self._staged_n < self.coalesce_rows:
+                return []
+            return self._drain_staged(keep_tail=True)
+        return self._inner.process_columnar(key_ids, jts, cols, nulls)
+
     def _emit_matches(self, side, brows, rep, mcodes, other, oidx,
                       jts) -> list[dict[str, Any]]:
         fast = self._fast_info()
@@ -608,14 +741,7 @@ class JoinExecutor(_JoinBase):
             key_ids = self._match_key_ids(mcodes)
             cols, nulls = self._match_cols(fast, side, brows, rep,
                                            other, oidx)
-            if self.coalesce_rows > 0:
-                self._staged.append((key_ids, jts, cols, nulls))
-                self._staged_n += len(key_ids)
-                if self._staged_n < self.coalesce_rows:
-                    return []
-                return self._drain_staged(keep_tail=True)
-            return self._inner.process_columnar(key_ids, jts, cols,
-                                                nulls)
+            return self._feed_inner_columnar(key_ids, jts, cols, nulls)
         # general path: materialize joined-row dicts (also the sample
         # source for the inner executor's construction)
         orows = other.rows[oidx]
@@ -646,8 +772,15 @@ class JoinExecutor(_JoinBase):
         return lut[mcodes]
 
     def flush_staged(self) -> list[dict[str, Any]]:
-        """Step the inner executor with every coalesced match row."""
-        return self._drain_staged(keep_tail=False)
+        """Step the inner executor with every lagging match: deferred
+        device match buffers fetch + decode first (they may stage into
+        the coalesce buffer), then every coalesced row steps."""
+        out = self._drain_matches() if self._pending_matches else []
+        rows = self._drain_staged(keep_tail=False)
+        if not out:
+            return rows
+        out.extend(rows)
+        return out
 
     def _drain_staged(self, *, keep_tail: bool) -> list[dict[str, Any]]:
         """Step coalesced matches. keep_tail=True steps only whole
@@ -713,9 +846,13 @@ class JoinExecutor(_JoinBase):
         return None
 
     def close_due_windows(self) -> list[dict[str, Any]]:
-        rows = self.flush_staged() if self._staged else []
-        rows.extend(super().close_due_windows())
-        return rows
+        from hstream_tpu.common.columnar import extend_rows
+
+        rows = (self.flush_staged()
+                if (self._staged or self._pending_matches) else [])
+        # flush_staged can surface a lone ColumnarEmit (no .extend)
+        rows = extend_rows(rows, super().close_due_windows())
+        return rows if rows is not None else []
 
     def _plan_fast(self) -> None:
         """Enable the columnar match path when (a) the inner executor
@@ -775,7 +912,6 @@ class JoinExecutor(_JoinBase):
         cols: dict[str, np.ndarray] = {}
         nulls: dict[str, np.ndarray] = {}
         src_cache: dict[tuple, list] = {}
-        _MISS = object()
         for name, (cside, col) in fast["need"].items():
             vals = src_cache.get((cside, col))
             if vals is None:
@@ -822,4 +958,923 @@ class JoinExecutor(_JoinBase):
             if msk.any():
                 nulls[name] = msk
         return cols, (nulls or None)
+
+    # ---- device-resident join ----------------------------------------------
+    #
+    # Once the columnar fast path is planned, both sides migrate onto
+    # the device (engine.lattice interval-join kernels): per-side
+    # sorted stores of (code, ts_rel, flags, packed needed columns),
+    # one fused probe+insert dispatch per micro-batch, one D2H fetch of
+    # the packed match buffer (deferrable/stackable via
+    # match_drain_depth), vmapped two-sided eviction on watermark
+    # advance, and epoch rebase instead of the host store's span abort.
+    # Host stores stay the equivalence-reference path
+    # (use_device_join=False).
+
+    DEVICE_STORE_CAPACITY = 1 << 14   # initial per-side slots (grows)
+    REBASE_REL_MS = 1 << 30           # re-anchor epoch past this
+
+    def _device_ready(self) -> bool:
+        if self._dev is not None:
+            return True
+        if not self.use_device_join:
+            return False
+        fast = self._fast_info()
+        if fast is None:
+            return False
+        return self._activate_device(fast)
+
+    def _activate_device(self, fast: dict) -> bool:
+        """Plan per-side column layouts from the fast-path need map and
+        migrate the host stores' contents into device stores. Each need
+        name stores on every side it can resolve from ('both' = bare
+        name with left precedence, stored on both sides with a present
+        bit)."""
+        from hstream_tpu.engine import lattice
+
+        lay: dict[str, list[tuple[str, str]]] = {"l": [], "r": []}
+        for name, (cside, col) in fast["need"].items():
+            for s in ("l", "r"):
+                if cside in (s, "both"):
+                    lay[s].append((name, col))
+        if max(len(lay["l"]), len(lay["r"])) > lattice.JOIN_MAX_COLS:
+            self.use_device_join = False  # flags word out of bits
+            return False
+        cap = self.DEVICE_STORE_CAPACITY
+        need = max(len(self._stores["l"]), len(self._stores["r"])) * 2
+        cap = round_up_pow2(need, lo=cap)
+        cands = [int(st.ts.min()) for st in self._stores.values()
+                 if len(st)]
+        if self.watermark >= 0:
+            cands.append(self.watermark)
+        t0 = (min(cands) - self.retention_ms) if cands else None
+        self._dev = {
+            "lay": lay,
+            "cap": cap,
+            "t0": t0,
+            "n": {"l": 0, "r": 0},
+            # match buffers start small and stick at the pow2 the
+            # workload's match totals actually need (the host shadow
+            # sizes them EXACTLY per batch, so they never overflow):
+            # a buffer sized to batch_capacity would make every fetch
+            # pay for a worst case that never happens
+            "match_cap": 4096,
+            "bcaps": set(),
+            "evict_cutoff": -(1 << 62),
+            "stores": {
+                "l": lattice.init_join_store(cap, len(lay["l"])),
+                "r": lattice.init_join_store(cap, len(lay["r"])),
+            },
+            # host shadow of each side's (code, ts) multiset, pruned at
+            # the probe cutoff: gives EXACT match totals before every
+            # dispatch (match buffers never overflow, the fused kernel
+            # can never silently truncate) for the cost of a rowless
+            # numpy insert+searchsorted per batch
+            "shadow": {"l": _FlatIntervalStore(self._jcode_rev),
+                       "r": _FlatIntervalStore(self._jcode_rev)},
+        }
+        self._dev["feed"] = self._build_feed_plans()
+        for s in ("l", "r"):
+            self._migrate_store(s)
+            self._stores[s] = _FlatIntervalStore(self._jcode_rev)
+        return True
+
+    def _build_feed_plans(self) -> dict | None:
+        """Hashable per-side plans mapping the inner step's needed
+        columns (and null masks) onto match-buffer sources, for the
+        fully fused probe->aggregate kernel. None when the inner
+        executor is not a device lattice (stateless joins keep the
+        match-fetch path)."""
+        from hstream_tpu.engine import lattice
+        from hstream_tpu.engine.expr import columns_of
+
+        inner = self._inner
+        if (getattr(inner, "spec", None) is None
+                or not hasattr(inner, "_null_specs")):
+            return None
+        lay_idx = {s: {name: j for j, (name, _c)
+                       in enumerate(self._dev["lay"][s])}
+                   for s in ("l", "r")}
+        plans: dict[str, tuple] = {}
+        for side in ("l", "r"):
+            other = "r" if side == "l" else "l"
+
+            def entry(name):
+                cside, _col = self._fast["need"][name]
+                jm = lay_idx[side].get(name, -1)
+                jo = lay_idx[other].get(name, -1)
+                if cside == side:
+                    return ("m", jm, jo)
+                if cside == other:
+                    return ("o", jm, jo)
+                # bare name, left precedence: the SQL left side is the
+                # probing batch when side == "l", else the probed store
+                return ("both" if side == "l" else "both_o", jm, jo)
+
+            feed = tuple(
+                (name, lattice.layout_tag(inner.schema.type_of(name)))
+                + entry(name)
+                for name in self._fast["need"])
+            nulls_plan = tuple(
+                (key, tuple(entry(c) for c in refs))
+                for key, refs in inner._null_specs)
+            filter_nulls = (tuple(
+                entry(c) for c in sorted(columns_of(inner._filter_expr)))
+                if inner._filter_expr is not None else ())
+            plans[side] = (feed, nulls_plan, filter_nulls)
+        return plans
+
+    def _migrate_store(self, side: str) -> None:
+        """Move one host store's live entries into the device store
+        (activation / snapshot restore): pack host rows into the device
+        entry layout and device_put directly — already (code, ts)
+        sorted, so no kernel dispatch is needed."""
+        import jax
+        import jax.numpy as jnp
+
+        st = self._stores[side]
+        n = len(st)
+        if n == 0:
+            return
+        dev = self._dev
+        dev["shadow"][side].insert_sorted(
+            st.code.copy(), st.ts.copy(), np.empty(n, object))
+        lay = dev["lay"][side]
+        flags, vals = self._encode_join_cols(
+            lay, [st.rows[i] for i in range(n)])
+        from hstream_tpu.engine import lattice
+
+        cap = dev["cap"]
+        code = np.full(cap, lattice.JOIN_SENT_CODE, np.int32)
+        code[:n] = st.code.astype(np.int32)
+        ts = np.zeros(cap, np.int32)
+        ts[:n] = (st.ts - dev["t0"]).astype(np.int32)
+        f32 = np.zeros(cap, np.int32)
+        f32[:n] = flags
+        cv = np.zeros((len(lay), cap), np.int32)
+        cv[:, :n] = vals
+        dev["stores"][side] = {
+            "code": jax.device_put(jnp.asarray(code)),
+            "ts": jax.device_put(jnp.asarray(ts)),
+            "flags": jax.device_put(jnp.asarray(f32)),
+            "cols": jax.device_put(jnp.asarray(cv)),
+        }
+        dev["n"][side] = n
+
+    def _encode_join_cols(self, lay, rows) -> tuple[np.ndarray,
+                                                    np.ndarray]:
+        """Pack one side's needed columns for a list of rows into
+        (flags i32[n], values i32[len(lay), n]): 2 bits per column in
+        flags (bit 2j = SQL NULL / non-scalar, bit 2j+1 = field
+        present), values f32-bitcast / i32 / bool / dictionary id —
+        the same per-value rules as the host fast path (_match_cols)."""
+        from hstream_tpu.engine.types import ColumnType
+
+        inner = self._inner
+        n = len(rows)
+        flags = np.zeros(n, np.int32)
+        vals = np.zeros((len(lay), n), np.int32)
+        for j, (name, col) in enumerate(lay):
+            nullb = np.int32(1 << (2 * j))
+            presb = np.int32(1 << (2 * j + 1))
+            want = inner.schema.type_of(name)
+            if want == ColumnType.STRING:
+                enc = inner.dicts[name].encode
+                arr = np.zeros(n, np.int32)
+                for i, r in enumerate(rows):
+                    v = r.get(col, _MISS)
+                    if v is _MISS:
+                        flags[i] |= nullb
+                    elif v is None:
+                        flags[i] |= nullb | presb
+                    else:
+                        arr[i] = enc(str(v))
+                        flags[i] |= presb
+                vals[j] = arr
+            else:
+                dt = (np.bool_ if want == ColumnType.BOOL
+                      else np.int32 if want == ColumnType.INT
+                      else np.float32)
+                arr = np.zeros(n, dt)
+                for i, r in enumerate(rows):
+                    v = r.get(col, _MISS)
+                    if v is _MISS:
+                        flags[i] |= nullb
+                    elif v is None or not isinstance(v, (int, float,
+                                                         bool)):
+                        flags[i] |= nullb | presb
+                    else:
+                        arr[i] = v
+                        flags[i] |= presb
+                vals[j] = (arr.view(np.int32) if dt is np.float32
+                           else arr.astype(np.int32))
+        return flags, vals
+
+    # ---- columnar ingest (vectorized encode, no row dicts) ----------------
+
+    def _columnar_batch(self, side, my_keys, ts, cols, nulls):
+        """Vectorized (codes, bts, flags, vals) in (code, ts) sorted
+        order for a columnar batch, or None when this batch cannot
+        encode columnar (non-Col ON keys, untyped columns) — the
+        caller materializes rows once and takes the row path."""
+        codes = self._batch_codes_columnar(my_keys, cols, nulls,
+                                           len(ts))
+        if codes is None:
+            return None
+        enc = self._encode_join_cols_columnar(
+            self._dev["lay"][side], cols, nulls, len(ts))
+        if enc is None:
+            return None
+        flags, vals = enc
+        keep = codes >= 0
+        if not keep.all():
+            kidx = np.nonzero(keep)[0]
+            codes = codes[kidx]
+            bts = ts[kidx]
+            flags = flags[kidx]
+            vals = vals[:, kidx]
+        else:
+            bts = ts
+        if not len(codes):
+            return codes, bts, flags, vals
+        order = np.lexsort((bts, codes))
+        return (codes[order], bts[order], flags[order],
+                vals[:, order])
+
+    def _batch_codes_columnar(self, my_keys, cols, nulls,
+                              n: int) -> np.ndarray | None:
+        """Dense join-key codes for a columnar batch: unique + encode
+        per DISTINCT value, one gather per row — the vectorized twin of
+        _batch_codes. None = fall back to the row path."""
+        if not all(isinstance(e, Col) for e in my_keys):
+            return None
+        # compact BEFORE encoding, like _batch_codes
+        if len(self._jcode_rev) + n >= (1 << 22) - 1:
+            self._compact_codes()
+            if len(self._jcode_rev) + n >= (1 << 22) - 1:
+                raise SQLCodegenError(
+                    "join key cardinality within the retention window "
+                    f"exceeds {1 << 22} distinct keys")
+        jcode = self._jcode
+        rev = self._jcode_rev
+
+        def code_of(k) -> int:
+            c = jcode.get(k)
+            if c is None:
+                c = len(rev)
+                jcode[k] = c
+                rev.append(k)
+            return c
+
+        col_vals: list[np.ndarray] = []
+        col_codes: list[np.ndarray] = []
+        null_any = np.zeros(n, np.bool_)
+        for e in my_keys:
+            arr = cols.get(e.name)
+            if arr is None:
+                return None if n else np.empty(0, np.int64)
+            nm = nulls.get(e.name) if nulls else None
+            if nm is not None:
+                null_any |= nm
+            try:
+                uniq, inv = np.unique(np.asarray(arr),
+                                      return_inverse=True)
+            except TypeError:
+                return None  # incomparable mixed values: row path
+            col_vals.append(uniq)
+            col_codes.append(inv.astype(np.int64))
+        if len(my_keys) == 1:
+            uniq = col_vals[0]
+            lut = np.fromiter(
+                (code_of(canon_key((v,))) for v in uniq.tolist()),
+                np.int64, len(uniq))
+            out = lut[col_codes[0]]
+        else:
+            combined = col_codes[0]
+            for inv, uniq in zip(col_codes[1:], col_vals[1:]):
+                combined = combined * len(uniq) + inv
+            u, uinv = np.unique(combined, return_inverse=True)
+            lut = np.empty(len(u), np.int64)
+            for i, cu in enumerate(u.tolist()):
+                idxs = []
+                for uniq in reversed(col_vals[1:]):
+                    idxs.append(cu % len(uniq))
+                    cu //= len(uniq)
+                idxs.append(cu)
+                idxs.reverse()
+                key = tuple(col_vals[k][i2].item()
+                            if hasattr(col_vals[k][i2], "item")
+                            else col_vals[k][i2]
+                            for k, i2 in enumerate(idxs))
+                lut[i] = code_of(canon_key(key))
+            out = lut[uinv]
+        if null_any.any():
+            out = np.where(null_any, -1, out)
+        return out
+
+    def _encode_join_cols_columnar(self, lay, cols, nulls, n: int):
+        """Vectorized twin of _encode_join_cols over whole columns:
+        (flags i32[n], vals i32[len(lay), n]), or None when a column's
+        dtype cannot encode without per-row inspection."""
+        from hstream_tpu.engine.types import ColumnType
+
+        inner = self._inner
+        flags = np.zeros(n, np.int32)
+        vals = np.zeros((len(lay), n), np.int32)
+        for j, (name, col) in enumerate(lay):
+            nullb = np.int32(1 << (2 * j))
+            presb = np.int32(1 << (2 * j + 1))
+            arr = cols.get(col)
+            if arr is None:
+                flags |= nullb  # field absent from every record
+                continue
+            arr = np.asarray(arr)
+            nm = nulls.get(col) if nulls else None
+            want = inner.schema.type_of(name)
+            if want == ColumnType.STRING:
+                enc = inner.dicts[name].encode
+                try:
+                    uniq, inv = np.unique(arr, return_inverse=True)
+                except TypeError:
+                    return None
+                lut = np.fromiter((enc(str(v)) for v in uniq.tolist()),
+                                  np.int32, len(uniq))
+                vals[j] = lut[inv]
+                row_flags = presb
+            else:
+                if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+                    return None  # untyped numerics: row path decides
+                try:
+                    if want == ColumnType.FLOAT:
+                        vals[j] = arr.astype(
+                            np.float32, copy=False).view(np.int32)
+                    elif want == ColumnType.BOOL:
+                        vals[j] = (np.asarray(arr) != 0).astype(
+                            np.int32)
+                    else:
+                        vals[j] = arr.astype(np.int32)
+                except (TypeError, ValueError):
+                    return None
+                row_flags = presb
+            flags |= row_flags
+            if nm is not None and nm.any():
+                # a null-masked cell is an ABSENT field (drop_null row
+                # parity): null bit on, present bit off, value zeroed
+                flags[nm] = (flags[nm] | nullb) & ~presb
+                vals[j, nm] = 0
+        return flags, vals
+
+    @staticmethod
+    def _rows_from_cols(cols, nulls, n: int) -> list[dict[str, Any]]:
+        """Materialize columnar input into per-row dicts (fallback /
+        host reference path) with null-masked cells dropped — the same
+        row shape the per-record decode produces, including
+        columnar.to_rows' f64 parity (integral doubles decode as ints,
+        like Struct number decoding)."""
+        host = {}
+        masks = {}
+        for name, arr in cols.items():
+            if isinstance(arr, np.ndarray) and arr.dtype == np.float64:
+                vals = [int(v) if v.is_integer() else v
+                        for v in arr.tolist()]
+            elif isinstance(arr, np.ndarray):
+                vals = arr.tolist()
+            else:
+                vals = list(arr)
+            nm = nulls.get(name) if nulls else None
+            if nm is not None and nm.any():
+                masks[name] = nm.tolist()
+            host[name] = vals
+        names = list(host)
+        if not names:
+            return [{} for _ in range(n)]
+        rows = [dict(zip(names, vv))
+                for vv in zip(*(host[c] for c in names))]
+        for name, mask in masks.items():
+            for row, isnull in zip(rows, mask):
+                if isnull:
+                    del row[name]
+        return rows
+
+    def _dev_bcap(self, n: int) -> int:
+        """Sticky pow2 batch capacity (each distinct shape is its own
+        XLA compile; varying batch sizes converge on a few)."""
+        caps = self._dev["bcaps"]
+        for c in sorted(caps):
+            if n <= c <= 8 * max(n, 1):
+                return c
+        cap = round_up_pow2(n, lo=1024)
+        caps.add(cap)
+        return cap
+
+    def _device_batch(self, side, codes, bts, flags, vals
+                      ) -> list[dict[str, Any]]:
+        """One micro-batch on the device path: pack, ONE device
+        dispatch. When the downstream aggregate can fuse, the dispatch
+        scatters the matched pairs straight into the inner lattice —
+        matches never leave the device; otherwise the packed match
+        buffer is the one (deferrable, stackable) D2H fetch. `flags` /
+        `vals` are the side's pre-encoded entry columns in (code, ts)
+        sorted order (row or columnar encoder)."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        n = len(codes)
+        if dev["t0"] is None:
+            dev["t0"] = int(bts.min()) - self.retention_ms
+        self._maybe_rebase(int(bts.min()), int(bts.max()))
+        if dev["n"][side] + n > dev["cap"]:
+            self._refresh_counts()  # upper bound -> exact
+        if dev["n"][side] + n > dev["cap"]:
+            # capacity pressure: evict with the PRE-batch watermark
+            # cutoff — the probe below must still see every entry the
+            # host reference would (it prunes only after the batch)
+            self._dispatch_evict(self.watermark - self.retention_ms, 0)
+            self._refresh_counts()
+            if dev["n"][side] + n > dev["cap"]:
+                self._grow_device(round_up_pow2(
+                    dev["n"][side] + n, lo=dev["cap"] * 2))
+            elif max(dev["n"].values()) + n > dev["cap"] // 2:
+                # hysteresis: an eviction that leaves the store more
+                # than half full would force another sort within a few
+                # batches — grow once instead of evicting every batch
+                self._grow_device(dev["cap"] * 2)
+        # exact match total from the host shadow (code/ts only): sizes
+        # the padded match width so the kernel can never truncate
+        other_side = "r" if side == "l" else "l"
+        cutoff_abs = (self.watermark - self.retention_ms
+                      if self.watermark >= 0 else None)
+        shadow_o = dev["shadow"][other_side]
+        lo_ts = bts - self.within
+        if cutoff_abs is not None:
+            lo_ts = np.maximum(lo_ts, cutoff_abs)
+        pr = shadow_o.probe(codes, lo_ts, bts + self.within)
+        total = int((pr[1] - pr[0]).sum()) if pr is not None else 0
+        dev["shadow"][side].insert_sorted(codes, bts,
+                                          np.empty(n, object))
+        if cutoff_abs is not None and cutoff_abs > 0:
+            dev["shadow"][side].prune(cutoff_abs)
+            shadow_o.prune(cutoff_abs)
+        if total > dev["match_cap"]:
+            dev["match_cap"] = round_up_pow2(total,
+                                             lo=dev["match_cap"] * 2)
+        kid = self._match_key_ids(codes)
+        lay = dev["lay"][side]
+        bcap = self._dev_bcap(n)
+        buf = np.zeros((4 + len(lay), bcap), np.int32)
+        buf[0, :n] = codes
+        buf[0, n:] = lattice.JOIN_SENT_CODE
+        buf[1, :n] = (bts - dev["t0"]).astype(np.int32)
+        buf[2, :n] = kid
+        buf[3, :n] = flags
+        if len(lay):
+            buf[4:, :n] = vals
+        other = dev["stores"][other_side]
+        # the probe-visible retention cutoff mirrors the host
+        # reference's prune-before-this-batch state: the device store
+        # may still hold older entries (eviction is lazy, capacity
+        # only), but matches must not see them
+        cutoff = np.int32(np.clip(
+            (cutoff_abs - dev["t0"]) if cutoff_abs is not None
+            else -(1 << 31), -(1 << 31), (1 << 31) - 1))
+        self.join_stats["probe_batches"] += 1
+        self.join_stats["probe_dispatches"] += 1
+        if dev.get("feed") is not None and self._fuse_ok(bts):
+            return self._fused_batch(side, other_side, buf, n, cutoff)
+        kern = lattice.join_probe_insert(
+            dev["cap"], bcap, dev["match_cap"], len(lay),
+            len(dev["lay"][other_side]))
+        dev["stores"][side], packed = kern(
+            dev["stores"][side], other, buf, np.int32(n),
+            np.int32(self.within), cutoff)
+        self._note_insert(side, n)
+        # the pending entry keeps (batch, other-store ref) alive so a
+        # truncated match buffer could re-probe wider (unreachable
+        # while the shadow sizes the width, kept as belt-and-braces)
+        self._pending_matches.append(
+            (packed, side, dev["t0"], buf, n, other, cutoff))
+        if len(self._pending_matches) >= max(self.match_drain_depth, 1):
+            return self._drain_matches()
+        return []
+
+    # ---- fused probe -> inner aggregate (zero per-batch D2H) --------------
+
+    def _fuse_ok(self, bts) -> bool:
+        """Whether this batch can take the fully fused kernel: the
+        inner executor's host window bookkeeping must be able to track
+        the conservative joined-ts range [min bts, max bts + within]
+        without a per-row scan — the windows-in-range set must fit the
+        fast gate and introduce no slot aliasing (mirrors _gap_guard's
+        collision check; a batch that fails falls back to the
+        match-fetch path, which runs the full guard)."""
+        inner = self._inner
+        w = inner.window
+        if w is None:
+            return True
+        if inner.epoch is not None and int(bts.min()) < inner.epoch:
+            return False  # pre-epoch joined ts: row path handles
+        adv = w.advance_ms
+        lo = int(bts.min())
+        hi = int(bts.max()) + self.within
+        span = (hi - hi % adv - (lo - lo % adv)) // adv + 1
+        back = w.windows_per_record - 1
+        if span + back > min(inner.spec.n_slots, 64):
+            return False
+        period = adv * inner.spec.n_slots
+        starts = np.arange(lo - lo % adv - back * adv,
+                           hi - hi % adv + adv, adv)
+        if inner.watermark_abs >= 0:
+            starts = starts[starts + w.size_ms + w.grace_ms
+                            > inner.watermark_abs]
+        cand = set(starts.tolist()) | set(inner._open)
+        by_res: dict[int, int] = {}
+        for s in cand:
+            r = s % period
+            if r in by_res and by_res[r] != s:
+                return False  # slot aliasing: let _gap_guard handle it
+            by_res[r] = s
+        return True
+
+    def _fused_batch(self, side, other_side, buf, n, cutoff
+                     ) -> list[dict[str, Any]]:
+        """Dispatch the probe+insert+inner-scatter kernel: the matched
+        pairs aggregate on device, so the batch costs ZERO D2H — the
+        changelog extract (already deferred/batched) is the only fetch
+        left on the join hot path."""
+        from hstream_tpu.common.columnar import extend_rows
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        inner = self._inner
+        lo = int(buf[1, :n].min()) + dev["t0"]
+        hi = int(buf[1, :n].max()) + dev["t0"] + self.within
+        inner._ensure_epoch(lo)
+        inner._maybe_rebase(hi)
+        # watermark forwarding: the joined stream's watermark is the
+        # JOIN's watermark (both paths apply the same sync in
+        # _feed_inner_columnar, so late-mask semantics stay identical)
+        if self.watermark > inner.watermark_abs:
+            inner.watermark_abs = self.watermark
+        wm_rel = np.int32(max(inner.watermark_abs - inner.epoch, -1)
+                          if inner.watermark_abs >= 0 else -1)
+        ts_off = np.int32(dev["t0"] - inner.epoch)
+        feed, nulls_plan, filter_nulls = dev["feed"][side]
+        kern = lattice.join_probe_insert_step(
+            dev["cap"], buf.shape[1], dev["match_cap"],
+            len(dev["lay"][side]), len(dev["lay"][other_side]),
+            inner.spec, inner.schema, inner._filter_expr, feed,
+            nulls_plan, filter_nulls)
+        dev["stores"][side], inner.state, _total = kern(
+            dev["stores"][side], dev["stores"][other_side], buf,
+            np.int32(n), np.int32(self.within), cutoff, inner.state,
+            wm_rel, ts_off)
+        self._note_insert(side, n)
+        self.join_stats["fused_batches"] += 1
+        # inner host bookkeeping over the conservative ts range (the
+        # overapproximated window set is semantics-free: empty windows
+        # close without emitting via the count>0 filter)
+        try:
+            if inner.window is not None:
+                inner._track_windows(np.asarray([lo, hi], np.int64))
+            bmax = hi - self.within  # this batch's max record ts
+            if bmax > inner.watermark_abs:
+                inner.watermark_abs = bmax
+            out = None
+            if inner.emit_changes:
+                out = extend_rows(out, inner._drain_changes())
+            out = extend_rows(out, inner.close_due_windows())
+            return list(out) if out is not None else []
+        finally:
+            inner._no_close.clear()
+            inner._touched_this_call.clear()
+
+    def _drain_matches(self) -> list[dict[str, Any]]:
+        """Fetch + decode every pending match buffer: buffers of one
+        shape stack into ONE device->host transfer (fetch count, not
+        bytes, dominates on real links), then decode columnar and feed
+        the inner executor."""
+        import jax.numpy as jnp
+
+        if not self._pending_matches:
+            return []
+        pending, self._pending_matches = self._pending_matches, []
+        # piggyback the deferred post-eviction counts on this sync:
+        # everything queued ahead of the match buffers has executed by
+        # the time they arrive, so the 2-int copy is free here and the
+        # host upper bound stays fresh without hot-loop blocking
+        self._refresh_counts()
+        host: list[tuple] = []
+        if len(pending) == 1:
+            packed, *rest = pending[0]
+            self.join_stats["probe_fetches"] += 1
+            host.append((np.asarray(packed), *rest))
+        else:
+            by_shape: dict[tuple, list] = {}
+            for ent in pending:
+                by_shape.setdefault(tuple(ent[0].shape), []).append(ent)
+            groups: dict[int, tuple] = {}
+            for group in by_shape.values():
+                self.join_stats["probe_fetches"] += 1
+                stacked = np.asarray(jnp.stack([e[0] for e in group]))
+                for ent, hbuf in zip(group, stacked):
+                    groups[id(ent)] = (hbuf, *ent[1:])
+            # preserve submission order across shape groups
+            host = [groups[id(ent)] for ent in pending]
+        out: list[dict[str, Any]] = []
+        for hbuf, side, t0, buf, n, other, cutoff in host:
+            nm = len(self._dev["lay"][side])
+            total = int(hbuf[0, 0])
+            if total > hbuf.shape[1]:
+                hbuf = self._reprobe_wider(side, buf, n, other, cutoff,
+                                           total)
+            out.extend(self._decode_matches(side, t0, hbuf, nm) or [])
+        return out
+
+    def _reprobe_wider(self, side, buf, n, other, cutoff,
+                       total) -> np.ndarray:
+        """Match-overflow redo: probe-only at the next pow2 width (the
+        batch is already inserted; `other` is the exact store the fused
+        kernel probed, `cutoff` its retention mask)."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        match_cap = round_up_pow2(total, lo=dev["match_cap"] * 2)
+        dev["match_cap"] = max(dev["match_cap"], match_cap)
+        other_side = "r" if side == "l" else "l"
+        kern = lattice.join_probe_only(
+            other["code"].shape[0], buf.shape[1], match_cap,
+            len(dev["lay"][side]), len(dev["lay"][other_side]))
+        self.join_stats["match_redispatches"] += 1
+        self.join_stats["probe_fetches"] += 1
+        return np.asarray(kern(other, buf, np.int32(n),
+                               np.int32(self.within), cutoff))
+
+    def _decode_matches(self, side, t0, hbuf, nm
+                        ) -> list[dict[str, Any]]:
+        """Columnar decode of a fetched match buffer into the inner
+        step's input: resolve each needed column from the probe/stored
+        side (left precedence for bare names via the present bits) —
+        the vectorized twin of _match_cols."""
+        from hstream_tpu.engine import lattice
+        from hstream_tpu.engine.types import ColumnType
+
+        total, kid, jts, mflags, oflags, mcols, ocols = \
+            lattice.unpack_join_matches(hbuf, nm)
+        m = len(kid)
+        if m == 0:
+            return []
+        dev = self._dev
+        other_side = "r" if side == "l" else "l"
+        lidx = {name: j for j, (name, _c)
+                in enumerate(dev["lay"]["l"])}
+        ridx = {name: j for j, (name, _c)
+                in enumerate(dev["lay"]["r"])}
+        phys = {side: (mflags, mcols), other_side: (oflags, ocols)}
+        inner = self._inner
+        cols: dict[str, np.ndarray] = {}
+        nulls: dict[str, np.ndarray] = {}
+        for name, (cside, _col) in self._fast["need"].items():
+            if cside == "both":
+                lf, lv = phys["l"]
+                rf, rv = phys["r"]
+                lj, rj = lidx[name], ridx[name]
+                lpres = ((lf >> (2 * lj + 1)) & 1).astype(np.bool_)
+                val = np.where(lpres, lv[lj], rv[rj])
+                nb = np.where(lpres, (lf >> (2 * lj)) & 1,
+                              (rf >> (2 * rj)) & 1)
+            else:
+                f, v = phys[cside]
+                j = lidx[name] if cside == "l" else ridx[name]
+                val = v[j]
+                nb = (f >> (2 * j)) & 1
+            want = inner.schema.type_of(name)
+            if want == ColumnType.FLOAT:
+                cols[name] = np.ascontiguousarray(
+                    val, np.int32).view(np.float32)
+            elif want == ColumnType.BOOL:
+                cols[name] = val != 0
+            else:
+                cols[name] = np.ascontiguousarray(val, np.int32)
+            msk = nb.astype(np.bool_)
+            if msk.any():
+                nulls[name] = msk
+        return self._feed_inner_columnar(
+            kid.astype(np.int32), jts.astype(np.int64) + t0, cols,
+            nulls or None)
+
+    def _maybe_rebase(self, min_ts: int, max_ts: int) -> None:
+        """Keep device-relative time inside int32: re-anchor the join
+        epoch down when an in-grace batch reaches below it, up when
+        stream time approaches the threshold — the rebase rides the
+        two-sided eviction kernel (delta arg), so it costs one rare
+        dispatch instead of the host store's span abort."""
+        dev = self._dev
+        # the eviction riding the rebase runs BEFORE this batch's
+        # probe, so its cutoff is the PRE-batch watermark's — exactly
+        # the prune state the host reference would probe against
+        cutoff_abs = ((self.watermark - self.retention_ms)
+                      if self.watermark >= 0 else dev["t0"])
+        if min_ts - dev["t0"] < 0:
+            delta = (min_ts - self.retention_ms) - dev["t0"]
+        elif max_ts - dev["t0"] >= self.REBASE_REL_MS:
+            delta = max(cutoff_abs - dev["t0"], 0)
+        else:
+            return
+        if max_ts - (dev["t0"] + delta) >= (1 << 31):
+            # the span guard must fire even when retention pins the
+            # epoch (delta == 0) — silently wrapping int32 relative
+            # time would corrupt probe bounds
+            raise SQLCodegenError(
+                "join record timestamps span more than the int32 "
+                "relative range even after epoch rebase; timestamps "
+                "must be epoch milliseconds")
+        if delta == 0:
+            return
+        self._dispatch_evict(cutoff_abs, delta)
+        self.join_stats["rebase_dispatches"] += 1
+
+    def _maybe_evict(self, cutoff_abs: int) -> None:
+        """Watermark-advance eviction policy: dispatch the two-sided
+        compaction once retention has advanced a full span past the
+        last one AND the stores hold enough dead weight to be worth a
+        sort (capacity pressure dispatches it unconditionally in
+        _device_batch)."""
+        dev = self._dev
+        if cutoff_abs - dev["evict_cutoff"] < max(self.retention_ms, 1):
+            return
+        if dev["n"]["l"] + dev["n"]["r"] < dev["cap"] // 2:
+            # mostly-empty stores: skip the sort, just note progress
+            dev["evict_cutoff"] = cutoff_abs
+            return
+        self._dispatch_evict(cutoff_abs, 0)
+
+    def _dispatch_evict(self, cutoff_abs: int, delta: int) -> None:
+        """One vmapped two-sided eviction (+ rebase) dispatch. The live
+        counts stay a DEVICE value (dev["pending_n"]) so the hot loop
+        never blocks on them; host-side dev["n"] remains a safe upper
+        bound (eviction only shrinks) and _refresh_counts() forces the
+        tiny fetch only when a capacity decision needs exact numbers."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        cutoff_rel = max(cutoff_abs - dev["t0"], 0)
+        kern = lattice.join_evict(dev["cap"], len(dev["lay"]["l"]),
+                                  len(dev["lay"]["r"]))
+        left, right, narr = kern(dev["stores"]["l"], dev["stores"]["r"],
+                                 np.int32(min(cutoff_rel, (1 << 31) - 1)),
+                                 np.int32(delta))
+        dev["stores"]["l"] = left
+        dev["stores"]["r"] = right
+        # the deferred count snapshot reflects the store AT THIS
+        # dispatch; inserts queued after it must be re-added when the
+        # snapshot is finally read (_refresh_counts), or the capacity
+        # upper bound would silently undercount and let the insert
+        # kernel truncate live entries
+        dev["pending_n"] = (narr, {"l": 0, "r": 0})
+        dev["t0"] += delta
+        dev["evict_cutoff"] = max(dev["evict_cutoff"], cutoff_abs)
+        self.join_stats["evict_dispatches"] += 1
+
+    def _note_insert(self, side: str, n: int) -> None:
+        """Count an insert against the host bound AND any in-flight
+        eviction snapshot."""
+        dev = self._dev
+        dev["n"][side] += n
+        pend = dev.get("pending_n")
+        if pend is not None:
+            pend[1][side] += n
+
+    def _refresh_counts(self) -> None:
+        """Force the deferred post-eviction live counts (2-int fetch),
+        re-adding inserts dispatched after the eviction."""
+        dev = self._dev
+        pend = dev.pop("pending_n", None)
+        if pend is not None:
+            narr, since = pend
+            n = np.asarray(narr)
+            dev["n"] = {"l": int(n[0]) + since["l"],
+                        "r": int(n[1]) + since["r"]}
+
+    def _grow_device(self, new_cap: int) -> None:
+        """Double a full store pair: pad every plane with empty slots
+        (code sentinel) on device — rare, host-driven."""
+        import jax.numpy as jnp
+
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        extra = new_cap - dev["cap"]
+        for s in ("l", "r"):
+            st = dev["stores"][s]
+            dev["stores"][s] = {
+                "code": jnp.pad(st["code"], (0, extra),
+                                constant_values=lattice.JOIN_SENT_CODE),
+                "ts": jnp.pad(st["ts"], (0, extra)),
+                "flags": jnp.pad(st["flags"], (0, extra)),
+                "cols": jnp.pad(st["cols"], ((0, 0), (0, extra))),
+            }
+        dev["cap"] = new_cap
+        self.join_stats["store_grows"] += 1
+
+    def _remap_device_codes(self, new_of_old: np.ndarray) -> None:
+        """Apply a code-space compaction to the device stores: live
+        codes keep their sorted order under compaction, so a gather
+        through the remap LUT suffices (no re-sort). Sentinel slots map
+        to themselves."""
+        import jax.numpy as jnp
+
+        from hstream_tpu.engine import lattice
+
+        lut = jnp.asarray(new_of_old.astype(np.int32))
+        for s in ("l", "r"):
+            st = self._dev["stores"][s]
+            code = st["code"]
+            live = code < np.int32(len(new_of_old))
+            st["code"] = jnp.where(
+                live, lut[jnp.where(live, code, 0)],
+                lattice.JOIN_SENT_CODE)
+
+    def device_store_counts(self) -> dict[str, int] | None:
+        """Live entries per device store side (tests/introspection)."""
+        if self._dev is None:
+            return None
+        self._refresh_counts()
+        return dict(self._dev["n"])
+
+    def _host_store_view(self) -> dict[str, "_FlatIntervalStore"]:
+        """The two side stores as host _FlatIntervalStores (snapshot
+        serialization, equivalence tests). Device mode fetches the
+        stores and reconstructs per-entry rows from the packed needed
+        columns — the only fields future matches can emit on the fast
+        path, so the view is faithful for every downstream consumer."""
+        if self._dev is None:
+            return self._stores
+        import jax
+
+        from hstream_tpu.engine.types import ColumnType
+
+        self._refresh_counts()
+        out: dict[str, _FlatIntervalStore] = {}
+        inner = self._inner
+        # the device store evicts lazily (capacity only) and hides
+        # expired entries from probes via the cutoff mask; the view
+        # applies the same retention filter so it matches the host
+        # reference's eagerly-pruned stores exactly
+        cutoff = (self.watermark - self.retention_ms
+                  if self.watermark >= 0 else None)
+        for side in ("l", "r"):
+            st = _FlatIntervalStore(self._jcode_rev)
+            n = self._dev["n"][side]
+            if n:
+                arrs = {k: np.asarray(v) for k, v in jax.device_get(
+                    self._dev["stores"][side]).items()}
+                if cutoff is not None:
+                    keep = (arrs["ts"][:n].astype(np.int64)
+                            + self._dev["t0"]) >= cutoff
+                    arrs = {
+                        "code": arrs["code"][:n][keep],
+                        "ts": arrs["ts"][:n][keep],
+                        "flags": arrs["flags"][:n][keep],
+                        "cols": arrs["cols"][:, :n][:, keep],
+                    }
+                    n = int(keep.sum())
+                if n == 0:
+                    out[side] = st
+                    continue
+                lay = self._dev["lay"][side]
+                decoded: list[tuple[str, list]] = []
+                flags = arrs["flags"][:n]
+                for j, (name, col) in enumerate(lay):
+                    want = inner.schema.type_of(name)
+                    raw = arrs["cols"][j, :n]
+                    nullm = ((flags >> (2 * j)) & 1).astype(np.bool_)
+                    presm = ((flags >> (2 * j + 1)) & 1).astype(
+                        np.bool_)
+                    if want == ColumnType.FLOAT:
+                        vv = np.ascontiguousarray(raw).view(np.float32)
+                        py = [float(x) for x in vv]
+                    elif want == ColumnType.BOOL:
+                        py = [bool(x) for x in raw]
+                    elif want == ColumnType.STRING:
+                        dec = inner.dicts[name].decode
+                        py = [dec(int(x)) if not nl else None
+                              for x, nl in zip(raw, nullm)]
+                    else:
+                        py = [int(x) for x in raw]
+                    decoded.append((col, [
+                        (_MISS if not p else (None if nl else v))
+                        for v, nl, p in zip(py, nullm, presm)]))
+                rows = np.empty(n, object)
+                for i in range(n):
+                    row = {}
+                    for col, vals in decoded:
+                        if vals[i] is not _MISS:
+                            row[col] = vals[i]
+                    rows[i] = row
+                st.insert_sorted(
+                    arrs["code"][:n].astype(np.int64),
+                    arrs["ts"][:n].astype(np.int64) + self._dev["t0"],
+                    rows)
+            out[side] = st
+        return out
 

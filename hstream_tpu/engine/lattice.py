@@ -805,6 +805,335 @@ def compiled_encoded_step(spec: LatticeSpec, schema, filter_expr,
                    donate_argnums=donate)
 
 
+# ---- interval-join lattice kernels ------------------------------------------
+#
+# The TPU analogue of the reference's timestamped two-sided KV stores
+# (Stream.hs:267-300 joinStreamProcessor): each join side is a device-
+# resident flat store of (key code, ts, packed columns) kept sorted by
+# (code, ts), and one fused jitted kernel per micro-batch
+#   * probes the OTHER side over each record's within-interval span
+#     [ts - within, ts + within] (a segmented two-sided bound over the
+#     sorted store, computed by a stable merge-rank — see
+#     _join_bounds), emitting matched pairs into ONE padded buffer, and
+#   * inserts the (pre-sorted) batch into THIS side's store with one
+#     2-key merge sort.
+# Watermark eviction is a separate vmapped kernel over both sides
+# (join_evict), dispatched by the host when retention advances; it also
+# carries the epoch-rebase delta so the int32 relative-time space never
+# overflows (the device restatement of _FlatIntervalStore's span
+# guard — rebase instead of abort).
+#
+# Everything is int32 (no x64 dependence): ts is milliseconds relative
+# to a host-managed join epoch, codes are the executor's dense join-key
+# codes, column values are f32-bitcast/i32/bool/dict-id int32 rows, and
+# per-entry null/present bits pack into one flags word (2 bits per
+# stored column). One dispatch + one D2H fetch (the match buffer) per
+# micro-batch, regardless of match count — match widths share compiled
+# shapes via the same pow2 padding trick as the fused window close.
+#
+# Batch layout (int32 [4 + n_cols, bcap], host-packed, sorted by
+# (code, ts)): row 0 code, row 1 ts_rel, row 2 inner key id, row 3
+# flags, rows 4+ packed column values.
+#
+# Match buffer (int32 [5 + n_cols_mine + n_cols_other, match_cap]):
+# row 0 header ([0] = true match total — may exceed match_cap, the
+# host then re-probes at the next pow2 width), row 1 inner key id,
+# row 2 joined ts (max of the pair, relative), row 3 probe-side flags,
+# row 4 stored-side flags, rows 5+ probe-side then stored-side columns.
+
+JOIN_SENT_CODE = (1 << 22)  # code sentinel: empty/evicted slots (> any
+                            # live code — the executor compacts at 2^22)
+JOIN_MAX_COLS = 14          # 2 bits (null, present) per column in one
+                            # int32 flags word
+
+
+def init_join_store(cap: int, n_cols: int) -> dict[str, jnp.ndarray]:
+    """One empty join side: all slots carry the code sentinel."""
+    return {
+        "code": jnp.full((cap,), JOIN_SENT_CODE, jnp.int32),
+        "ts": jnp.zeros((cap,), jnp.int32),
+        "flags": jnp.zeros((cap,), jnp.int32),
+        "cols": jnp.zeros((n_cols, cap), jnp.int32),
+    }
+
+
+def _join_bounds(store_code, store_ts, qcode, lo_ts, hi_ts):
+    """Vectorized [lower, upper) bounds of each query's (code, ts)
+    span in a store sorted by (code, ts) — int32-safe searchsorted over
+    a 2-key space. ONE stable 3-key sort ranks both query sets among
+    the store entries: a query landing at final position p with k
+    queries (of either set) before it has exactly p - k store entries
+    before it, which IS its bound. The tie-break tag orders lo-queries
+    BEFORE equal-key store entries (lower bound) and hi-queries AFTER
+    them (upper bound)."""
+    cap = store_code.shape[0]
+    bcap = qcode.shape[0]
+    codes = jnp.concatenate([store_code, qcode, qcode])
+    tss = jnp.concatenate([store_ts, lo_ts, hi_ts])
+    tags = jnp.concatenate([jnp.ones((cap,), jnp.int32),
+                            jnp.zeros((bcap,), jnp.int32),
+                            jnp.full((bcap,), 2, jnp.int32)])
+    pay = jnp.concatenate([jnp.full((cap,), 2 * bcap, jnp.int32),
+                           jnp.arange(bcap, dtype=jnp.int32),
+                           bcap + jnp.arange(bcap, dtype=jnp.int32)])
+    _, _, _, spay = jax.lax.sort((codes, tss, tags, pay), num_keys=3)
+    pos = jnp.arange(cap + 2 * bcap, dtype=jnp.int32)
+    is_q = spay < 2 * bcap
+    k = jnp.cumsum(is_q.astype(jnp.int32)) - 1
+    bounds = jnp.zeros((2 * bcap,), jnp.int32).at[
+        jnp.where(is_q, spay, 2 * bcap)].set(pos - k, mode="drop")
+    return bounds[:bcap], bounds[bcap:]
+
+
+def _join_match_arrays(other, batch, n, within, cutoff, bcap: int,
+                       match_cap: int, owned=None):
+    """Shared probe core: expand the per-record [lower, upper) spans
+    into padded match index arrays. Returns (total, rec, oidx, mvalid,
+    jts) — rec indexes the probing batch, oidx the probed store."""
+    cap = other["code"].shape[0]
+    bcode = batch[0]
+    bts = batch[1]
+    bvalid = (jnp.arange(bcap) < n) & (bcode < JOIN_SENT_CODE)
+    if owned is not None:
+        bvalid = bvalid & owned
+    qcode = jnp.where(bvalid, bcode, JOIN_SENT_CODE)
+    lo_i, hi_i = _join_bounds(other["code"], other["ts"], qcode,
+                              jnp.maximum(bts - within, cutoff),
+                              bts + within)
+    cnt = jnp.where(bvalid, jnp.maximum(hi_i - lo_i, 0), 0)
+    ccnt = jnp.cumsum(cnt)
+    total = ccnt[-1]
+    j = jnp.arange(match_cap, dtype=jnp.int32)
+    rec = jnp.clip(jnp.searchsorted(ccnt, j, side="right"), 0, bcap - 1)
+    mvalid = j < jnp.minimum(total, match_cap)
+    oidx = lo_i[rec] + (j - (ccnt[rec] - cnt[rec]))
+    oidx = jnp.where(mvalid, jnp.clip(oidx, 0, cap - 1), 0)
+    jts = jnp.where(mvalid, jnp.maximum(bts[rec], other["ts"][oidx]), 0)
+    return total, rec, oidx, mvalid, jts
+
+
+def _join_probe(other, batch, n, within, cutoff, bcap: int,
+                match_cap: int, n_cols_mine: int, owned=None):
+    """Probe `other` with the batch; emit the packed match buffer (see
+    module comment). `cutoff` masks entries past retention out of the
+    probe (the lower bound is max(ts - within, cutoff)): the host
+    reference prunes its stores on every watermark advance, so the
+    device store — which evicts lazily, for capacity only — must hide
+    expired entries from matches to stay equivalent. `owned`
+    (bool[bcap] or None) additionally masks which batch records this
+    shard probes/inserts (key-sharded mirror)."""
+    total, rec, oidx, mvalid, jts = _join_match_arrays(
+        other, batch, n, within, cutoff, bcap, match_cap, owned)
+    header = jnp.zeros((match_cap,), jnp.int32).at[0].set(total)
+    rows = [header,
+            jnp.where(mvalid, batch[2][rec], 0),                 # kid
+            jts,
+            jnp.where(mvalid, batch[3][rec], 0),                 # my flags
+            jnp.where(mvalid, other["flags"][oidx], 0)]
+    mcols = jnp.where(mvalid[None, :], batch[4:4 + n_cols_mine][:, rec], 0)
+    ocols = jnp.where(mvalid[None, :], other["cols"][:, oidx], 0)
+    return jnp.concatenate([jnp.stack(rows), mcols, ocols], axis=0)
+
+
+def _join_insert(mine, batch, n, bcap: int, n_cols: int, owned=None):
+    """Merge the (pre-sorted) batch into a sorted store: one stable
+    2-key sort of the concatenation; overflow never truncates live
+    entries because the host checks capacity before dispatching."""
+    cap = mine["code"].shape[0]
+    bcode = batch[0]
+    bvalid = (jnp.arange(bcap) < n) & (bcode < JOIN_SENT_CODE)
+    if owned is not None:
+        bvalid = bvalid & owned
+    code = jnp.concatenate(
+        [mine["code"], jnp.where(bvalid, bcode, JOIN_SENT_CODE)])
+    ts = jnp.concatenate([mine["ts"], batch[1]])
+    idx = jnp.arange(cap + bcap, dtype=jnp.int32)
+    scode, sts, order = jax.lax.sort((code, ts, idx), num_keys=2)
+    order = order[:cap]
+    flags = jnp.concatenate([mine["flags"], batch[3]])[order]
+    cols = jnp.concatenate([mine["cols"], batch[4:4 + n_cols]],
+                           axis=1)[:, order]
+    return {"code": scode[:cap], "ts": sts[:cap], "flags": flags,
+            "cols": cols}
+
+
+@functools.lru_cache(maxsize=256)
+def join_probe_insert(cap: int, bcap: int, match_cap: int,
+                      n_cols_mine: int, n_cols_other: int):
+    """The fused per-micro-batch kernel: probe the other side, insert
+    into mine — ONE device dispatch; the match buffer is the one D2H
+    fetch. (state_mine, state_other, batch, n, within, cutoff) ->
+    (state_mine', packed matches)."""
+
+    @jax.jit
+    def probe_insert(mine, other, batch, n, within, cutoff):
+        packed = _join_probe(other, batch, n, within, cutoff, bcap,
+                             match_cap, n_cols_mine)
+        return _join_insert(mine, batch, n, bcap, n_cols_mine), packed
+
+    return probe_insert
+
+
+@functools.lru_cache(maxsize=256)
+def join_probe_only(cap: int, bcap: int, match_cap: int,
+                    n_cols_mine: int, n_cols_other: int):
+    """Probe without insert: the match-overflow redo path (the batch is
+    already inserted; the other side is unchanged, so re-probing at a
+    wider match_cap is exact)."""
+
+    @jax.jit
+    def probe(other, batch, n, within, cutoff):
+        return _join_probe(other, batch, n, within, cutoff, bcap,
+                           match_cap, n_cols_mine)
+
+    return probe
+
+
+@functools.lru_cache(maxsize=256)
+def join_probe_insert_step(cap: int, bcap: int, match_cap: int,
+                           n_cols_mine: int, n_cols_other: int,
+                           inner_spec: "LatticeSpec", schema,
+                           filter_expr, feed_plan, nulls_plan,
+                           filter_nulls):
+    """The FULLY fused interval-join kernel: probe the other side,
+    insert into mine, and scatter the matched pairs straight into the
+    downstream aggregate lattice — matches never leave the device, so
+    the per-micro-batch D2H cost drops to zero (the changelog extract
+    is the only remaining fetch, already batched/deferred).
+
+    `feed_plan` maps the inner step's needed columns onto match
+    sources, one hashable entry per column:
+        (name, tag, src, j_mine, j_other)
+    src "m" gathers from the probing batch, "o" from the probed store,
+    "both" resolves per match by the LEFT side's present bit (bare-name
+    left precedence; j_mine indexes my side's layout, j_other the
+    other's — which physical side is "left" is baked into the plan by
+    the caller). `nulls_plan` builds each aggregate's __null_a{i}
+    column as the OR of its referenced columns' null bits, and
+    `filter_nulls` masks records whose WHERE columns are NULL out of
+    `valid` (SQL: NULL predicate is not-true).
+
+    (mine, other, batch, n, within, cutoff, inner_state, wm_rel,
+     ts_off) -> (mine', inner_state', total_matches i32)
+    """
+    agg_inputs, _null_keys = compile_agg_inputs(inner_spec, schema)
+    from hstream_tpu.engine.expr import compile_device
+
+    filter_fn = (compile_device(filter_expr, schema)
+                 if filter_expr is not None else None)
+    base_step = build_step_fn(inner_spec, agg_inputs, filter_fn)
+
+    @jax.jit
+    def probe_insert_step(mine, other, batch, n, within, cutoff,
+                          inner_state, wm_rel, ts_off):
+        total, rec, oidx, mvalid, jts = _join_match_arrays(
+            other, batch, n, within, cutoff, bcap, match_cap)
+        mflags = batch[3][rec]
+        oflags = other["flags"][oidx]
+
+        def lpres_of(src, jm, jo):
+            # which physical side is the SQL left side: "both" = the
+            # probing batch, "both_o" = the probed store
+            if src == "both":
+                return ((mflags >> (2 * jm + 1)) & 1) != 0
+            return ((oflags >> (2 * jo + 1)) & 1) != 0
+
+        def null_bit(src, jm, jo):
+            mnull = (((mflags >> (2 * jm)) & 1) != 0 if jm >= 0
+                     else None)
+            onull = (((oflags >> (2 * jo)) & 1) != 0 if jo >= 0
+                     else None)
+            if src == "m":
+                return mnull
+            if src == "o":
+                return onull
+            left, right = ((mnull, onull) if src == "both"
+                           else (onull, mnull))
+            return jnp.where(lpres_of(src, jm, jo), left, right)
+
+        def raw_val(src, jm, jo):
+            mv = batch[4 + jm][rec] if jm >= 0 else 0
+            ov = other["cols"][jo][oidx] if jo >= 0 else 0
+            if src == "m":
+                return mv
+            if src == "o":
+                return ov
+            left, right = (mv, ov) if src == "both" else (ov, mv)
+            return jnp.where(lpres_of(src, jm, jo), left, right)
+
+        cols = {}
+        for name, tag, src, jm, jo in feed_plan:
+            raw = raw_val(src, jm, jo)
+            if tag == "f32":
+                cols[name] = jax.lax.bitcast_convert_type(raw,
+                                                          jnp.float32)
+            elif tag == "bool":
+                cols[name] = raw != 0
+            else:
+                cols[name] = raw
+        for null_key, refs in nulls_plan:
+            m = jnp.zeros((match_cap,), jnp.bool_)
+            for src, jm, jo in refs:
+                m = m | null_bit(src, jm, jo)
+            cols[null_key] = m
+        valid = mvalid
+        for src, jm, jo in filter_nulls:
+            valid = valid & ~null_bit(src, jm, jo)
+        ts_inner = jts + ts_off
+        kid = jnp.where(mvalid, batch[2][rec], 0)
+        new_inner = base_step(inner_state, wm_rel, kid, ts_inner,
+                              valid, cols)
+        new_mine = _join_insert(mine, batch, n, bcap, n_cols_mine)
+        return new_mine, new_inner, total
+
+    return probe_insert_step
+
+
+@functools.lru_cache(maxsize=256)
+def join_evict(cap: int, n_cols_l: int, n_cols_r: int):
+    """Vmapped two-sided eviction + epoch rebase: drop entries past the
+    retention cutoff from BOTH stores and shift surviving timestamps by
+    -delta (0 outside a rebase), in one dispatch. The (code, ts) core
+    compaction is vmapped over the side axis; the per-side column
+    gathers ride the same jit. Returns (left', right', live counts
+    i32[2]) — the count fetch is the only extra transfer eviction
+    costs, and it is rare."""
+
+    def _core(code, ts, cutoff, delta):
+        alive = (code < JOIN_SENT_CODE) & (ts >= cutoff)
+        code2 = jnp.where(alive, code, JOIN_SENT_CODE)
+        ts2 = jnp.where(alive, ts - delta, 0)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        scode, sts, order = jax.lax.sort((code2, ts2, idx), num_keys=2)
+        return scode, sts, order, jnp.sum(alive.astype(jnp.int32))
+
+    @jax.jit
+    def evict(left, right, cutoff, delta):
+        code = jnp.stack([left["code"], right["code"]])
+        ts = jnp.stack([left["ts"], right["ts"]])
+        scode, sts, order, n = jax.vmap(
+            _core, in_axes=(0, 0, None, None))(code, ts, cutoff, delta)
+        out = []
+        for s, st in enumerate((left, right)):
+            out.append({"code": scode[s], "ts": sts[s],
+                        "flags": st["flags"][order[s]],
+                        "cols": st["cols"][:, order[s]]})
+        return out[0], out[1], n
+
+    return evict
+
+
+def unpack_join_matches(packed: np.ndarray, n_cols_mine: int):
+    """(total, kid, jts_rel, my_flags, other_flags, my_cols, other_cols)
+    from a fetched match buffer; arrays sliced to the in-buffer match
+    count (total may exceed it — the caller re-probes wider)."""
+    total = int(packed[0, 0])
+    m = min(total, packed.shape[1])
+    return (total, packed[1, :m], packed[2, :m], packed[3, :m],
+            packed[4, :m], packed[5:5 + n_cols_mine, :m],
+            packed[5 + n_cols_mine:, :m])
+
+
 @jax.jit
 def rebase(state, delta):
     """Shift device-relative time by -delta (host re-anchored the epoch)."""

@@ -379,10 +379,12 @@ def _restore_table_join(plan, meta, arrays, *, initial_keys: int,
 # ---- join -------------------------------------------------------------------
 
 def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
-    if getattr(ex, "_staged", None):
-        # coalesced matches live outside the inner executor's state;
-        # the owning runtime must flush_staged() (sinking the emitted
-        # rows) before a snapshot, like deferred changelog extracts
+    if getattr(ex, "_staged", None) or getattr(ex, "_pending_matches",
+                                               None):
+        # coalesced matches / deferred device match buffers live
+        # outside the inner executor's state; the owning runtime must
+        # flush_staged() (sinking the emitted rows) before a snapshot,
+        # like deferred changelog extracts
         raise SQLCodegenError(
             "snapshot with coalesced join matches staged; "
             "flush_staged() first")
@@ -391,12 +393,18 @@ def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
         return [{"k": _enc(key), "t": tss, "r": rows}
                 for key, (tss, rows) in store.by_key.items()]
 
+    # device-resident stores serialize through the same host view
+    # (fetch + row reconstruction from the packed needed columns);
+    # restore refills the host stores and the device re-activates and
+    # re-migrates lazily on the next probe
+    stores = (ex._host_store_view() if hasattr(ex, "_host_store_view")
+              else ex._stores)
     meta = {
         "kind": "join",
         "batch_capacity": ex._batch_capacity,
         "watermark": ex.watermark,
         "stores": {side: dump_store(st)
-                   for side, st in ex._stores.items()},
+                   for side, st in stores.items()},
     }
     arrays = {}
     if ex._inner is not None:

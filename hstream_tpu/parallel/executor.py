@@ -27,8 +27,8 @@ class ShardedQueryExecutor(QueryExecutor):
     logged; size capacity generously for production queries.
     """
 
-    # the sharded drain path (drain_touched) is synchronous; the
-    # deferral flag would be a silent no-op here
+    # the sharded drain path fetches synchronously (one transfer of
+    # the per-shard stack); the deferral flag would be a silent no-op
     supports_deferred_changes = False
 
     def __init__(self, node: AggregateNode, schema: Schema, *, mesh,
@@ -135,17 +135,17 @@ class ShardedQueryExecutor(QueryExecutor):
             cols, null_masks, self._layout)
         self.state = self._step(self.state, wm_rel, packed)
 
-    def _drain_changes(self) -> list[dict[str, Any]]:
-        self.state, touched = self._sharded.drain_touched(self.state)
-        rows = []
-        for kid, ws_rel, outs in touched:
-            ws = ws_rel + self.epoch if self.window is not None else None
-            row = self._agg_row_from_scalars(kid, outs, ws)
-            if row is not None:
-                rows.append(row)
-        return rows
+    def _drain_changes(self):
+        """Columnar sharded changelog drain: ONE host fetch of the
+        per-key-shard packed buffers, then the same batched decode the
+        single-chip path uses (kid rows already carry GLOBAL key ids).
+        A lone shard's batch stays a ColumnarEmit."""
+        from hstream_tpu.common.columnar import extend_rows
 
-    def _agg_row_from_scalars(self, kid: int, outs: dict[str, float],
-                              win_start_abs: int | None):
-        arr = {k: np.asarray([v]) for k, v in outs.items()}
-        return self._agg_row(kid, arr, 0, win_start_abs)
+        self.state, packed = self._extract_touched(self.state)
+        packed = np.asarray(packed)        # [n_key_shards, rows, max_out]
+        out = None
+        for s in range(self._sharded.n_key):
+            out = extend_rows(out, self._decode_changes(packed[s],
+                                                        self.epoch))
+        return out if out is not None else []

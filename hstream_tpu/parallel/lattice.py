@@ -302,19 +302,139 @@ class ShardedLattice:
             in_specs=(spec_tree,),
             out_specs=(spec_tree, P(key_axis)), check_vma=False))
 
-    # ---- host-side helpers -------------------------------------------------
+# ---- key-sharded interval join ----------------------------------------------
+#
+# The shard_map mirror of engine.lattice's interval-join kernels: each
+# key shard owns the join-key codes with ``code % n_shards == shard``,
+# holds its own slice of both side stores, probes/inserts only the
+# batch records it owns (the batch is replicated along the key axis —
+# the ownership mask does the routing, like the aggregation lattice),
+# and the per-shard match buffers CONCATENATE over ICI into one
+# [rows, n_shards * match_cap] buffer before the single host fetch.
+# Per-shard headers sit at column s * match_cap.
 
-    def drain_touched(self, state):
-        """Run extract_touched and flatten the per-key-shard results into
-        (state', [(kid_global, win_start_rel, {name: value})...]) — one
-        host fetch for the whole changelog."""
-        state, packed = self.extract_touched(state)
-        packed = np.asarray(packed)
-        rows = []
-        for s in range(self.n_key):
-            n, kidx, ws, outs = lattice.unpack_touched_rows(
-                self.local_spec, packed[s])
-            for i in range(n):
-                rows.append((int(kidx[i]), int(ws[i]),
-                             {k: float(v[i]) for k, v in outs.items()}))
-        return state, rows
+
+class ShardedJoinLattice:
+    """Both sides of one interval join, key-sharded over a mesh axis.
+
+    Capacities are PER SHARD. Drop-in twin of the single-chip kernels:
+    ``probe_insert(mine, other, batch, n, within, cutoff)`` returns
+    (mine', packed [rows, n_shards * match_cap]); ``evict(left, right,
+    cutoff, delta)`` compacts both sides per shard and returns the
+    per-shard live counts [n_shards, 2]."""
+
+    def __init__(self, mesh: Mesh, key_axis: str, cap: int, bcap: int,
+                 match_cap: int, n_cols_l: int, n_cols_r: int):
+        self.mesh = mesh
+        self.key_axis = key_axis
+        self.n_shards = mesh.shape[key_axis]
+        self.cap = cap
+        self.bcap = bcap
+        self.match_cap = match_cap
+        self.n_cols = {"l": n_cols_l, "r": n_cols_r}
+        self._build()
+
+    def init_store(self, side: str) -> dict[str, jnp.ndarray]:
+        """Per-shard empty stores stacked on a leading shard axis and
+        placed with the key-axis sharding."""
+        local = lattice.init_join_store(self.cap, self.n_cols[side])
+        out = {}
+        for k, v in local.items():
+            g = jnp.broadcast_to(v[None], (self.n_shards,) + v.shape)
+            out[k] = jax.device_put(g, NamedSharding(
+                self.mesh, P(self.key_axis)))
+        return out
+
+    def _build(self) -> None:
+        mesh, key_axis = self.mesh, self.key_axis
+        n_shards = self.n_shards
+        bcap, match_cap = self.bcap, self.match_cap
+        store_spec = {k: P(key_axis) for k in ("code", "ts", "flags",
+                                               "cols")}
+
+        def owned_mask(bcode):
+            shard = jax.lax.axis_index(key_axis)
+            return (bcode % n_shards) == shard
+
+        def probe_insert_local(mine, other, batch, n, within, cutoff,
+                               nm, no):
+            m = {k: v[0] for k, v in mine.items()}
+            o = {k: v[0] for k, v in other.items()}
+            owned = owned_mask(batch[0])
+            packed = lattice._join_probe(o, batch, n, within, cutoff,
+                                         bcap, match_cap, nm,
+                                         owned=owned)
+            new = lattice._join_insert(m, batch, n, bcap, nm,
+                                       owned=owned)
+            return {k: v[None] for k, v in new.items()}, packed
+
+        def mk_probe(nm, no):
+            def f(mine, other, batch, n, within, cutoff):
+                return probe_insert_local(mine, other, batch, n,
+                                          within, cutoff, nm, no)
+
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(store_spec, store_spec, P(), P(), P(), P()),
+                out_specs=(store_spec, P(key_axis)), check_vma=False))
+
+        self.probe_insert_l = mk_probe(self.n_cols["l"],
+                                       self.n_cols["r"])
+        self.probe_insert_r = mk_probe(self.n_cols["r"],
+                                       self.n_cols["l"])
+
+        cap = self.cap
+
+        def evict_local(left, right, cutoff, delta):
+            def _core(code, ts):
+                alive = (code < lattice.JOIN_SENT_CODE) & (ts >= cutoff)
+                code2 = jnp.where(alive, code, lattice.JOIN_SENT_CODE)
+                ts2 = jnp.where(alive, ts - delta, 0)
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                return jax.lax.sort((code2, ts2, idx), num_keys=2) + (
+                    jnp.sum(alive.astype(jnp.int32)),)
+
+            outs = []
+            ns = []
+            for st in (left, right):
+                scode, sts, order, n = _core(st["code"][0], st["ts"][0])
+                outs.append({"code": scode[None], "ts": sts[None],
+                             "flags": st["flags"][0][order][None],
+                             "cols": st["cols"][0][:, order][None]})
+                ns.append(n)
+            return outs[0], outs[1], jnp.stack(ns)[None]
+
+        self.evict = jax.jit(jax.shard_map(
+            evict_local, mesh=mesh,
+            in_specs=(store_spec, store_spec, P(), P()),
+            out_specs=(store_spec, store_spec, P(key_axis)),
+            check_vma=False))
+
+    def probe_insert(self, side: str, mine, other, batch, n, within,
+                     cutoff):
+        fn = (self.probe_insert_l if side == "l"
+              else self.probe_insert_r)
+        return fn(mine, other, batch, n, within, cutoff)
+
+    def unpack_matches(self, packed: np.ndarray, side: str):
+        """Flatten the shard-concatenated match buffer into host arrays
+        in shard order: (total, kid, jts_rel, my_flags, other_flags,
+        my_cols, other_cols) — the sharded twin of
+        lattice.unpack_join_matches. `total` sums the per-shard headers;
+        truncation per shard is visible as total > len(kid)."""
+        nm = self.n_cols[side]
+        parts = []
+        total = 0
+        for s in range(self.n_shards):
+            seg = packed[:, s * self.match_cap:(s + 1) * self.match_cap]
+            t, kid, jts, mf, of, mc, oc = lattice.unpack_join_matches(
+                seg, nm)
+            total += t
+            parts.append((kid, jts, mf, of, mc, oc))
+        return (total,
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]),
+                np.concatenate([p[3] for p in parts]),
+                np.concatenate([p[4] for p in parts], axis=1),
+                np.concatenate([p[5] for p in parts], axis=1))

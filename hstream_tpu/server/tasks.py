@@ -123,6 +123,7 @@ class QueryTask(threading.Thread):
         self._pending_ckps: dict[int, int] = {}  # processed, not committed
         self._last_flow_feed = 0.0  # overload-signal feed rate limit
         self._flow_chunks = 0       # warmup chunks skipped (jit compile)
+        self._join_probe_seen = 0   # join probe dispatches mirrored out
         self._last_snapshot_ms = 0.0
         self._last_persist_ms = 0.0   # cost of the last state write
         self._last_inline_ms = 0.0    # capture-side stall of last snap
@@ -649,18 +650,29 @@ class QueryTask(threading.Thread):
                     _sample_rows(ts, cols, nulls), len(ts))
             ex = self.executor
             if self.is_join or not hasattr(ex, "process_columnar"):
-                with trace_span(self.tracer, "decode"):
-                    # drop_null: a record never mentions columns it
-                    # doesn't carry — same row shape as the per-record
-                    # decode path, independent of producer batching
-                    rws = columnar.to_rows(ts, cols, nulls,
-                                           drop_null=True)
-                with trace_span(self.tracer, "step"):
-                    if self.is_join:
-                        out = ex.process(rws, ts.tolist(),
-                                         stream=self._sources[logid])
-                    else:
-                        out = ex.process(rws, ts.tolist())
+                if self.is_join and getattr(ex, "supports_columnar_join",
+                                            False):
+                    # stream-stream joins take the batch COLUMNAR: the
+                    # join packs device entries straight from the
+                    # arrays (null-masked cells = absent fields, the
+                    # drop_null row shape) — no row dicts on this path
+                    out = self._run_join_cols(
+                        ex, ts, _plain_columns(cols), nulls, logid)
+                else:
+                    with trace_span(self.tracer, "decode"):
+                        # drop_null: a record never mentions columns it
+                        # doesn't carry — same row shape as the
+                        # per-record decode path, independent of
+                        # producer batching
+                        rws = columnar.to_rows(ts, cols, nulls,
+                                               drop_null=True)
+                    with trace_span(self.tracer, "step"):
+                        if self.is_join:
+                            out = ex.process(
+                                rws, ts.tolist(),
+                                stream=self._sources[logid])
+                        else:
+                            out = ex.process(rws, ts.tolist())
                 if out:
                     with trace_span(self.tracer, "emit"):
                         self.sink(out)
@@ -742,6 +754,7 @@ class QueryTask(threading.Thread):
                 if self.is_join:
                     out = ex.process(rows, ts,
                                      stream=self._sources[logid])
+                    self._note_join_stats(ex, logid)
                 else:
                     out = ex.process(rows, ts)
             # sink under the lock: a window removed from live state must
@@ -773,16 +786,21 @@ class QueryTask(threading.Thread):
                     _sample_rows(ts, cols), len(ts))
             ex = self.executor
             if self.is_join or not hasattr(ex, "process_columnar"):
-                # joins / sessions / stateless: row materialization
-                with trace_span(self.tracer, "decode"):
-                    rws = columnar.to_rows(ts, cols)
-                with trace_span(self.tracer, "step"):
-                    if self.is_join:
-                        out = ex.process(
-                            rws, ts.tolist(),
-                            stream=self._sources[logid])
-                    else:
-                        out = ex.process(rws, ts.tolist())
+                if self.is_join and getattr(ex, "supports_columnar_join",
+                                            False):
+                    out = self._run_join_cols(
+                        ex, ts, _plain_columns(cols), None, logid)
+                else:
+                    # sessions / stateless: row materialization
+                    with trace_span(self.tracer, "decode"):
+                        rws = columnar.to_rows(ts, cols)
+                    with trace_span(self.tracer, "step"):
+                        if self.is_join:
+                            out = ex.process(
+                                rws, ts.tolist(),
+                                stream=self._sources[logid])
+                        else:
+                            out = ex.process(rws, ts.tolist())
                 if out:
                     with trace_span(self.tracer, "emit"):
                         self.sink(out)
@@ -807,6 +825,26 @@ class QueryTask(threading.Thread):
             with trace_span(self.tracer, "emit"):
                 self.sink(out)
 
+    def _run_join_cols(self, ex, ts, plain, nulls, logid):
+        """Columnar dispatch into a stream-stream join executor."""
+        with trace_span(self.tracer, "step"):
+            out = ex.process_columnar(
+                ts, plain, nulls, stream=self._sources[logid])
+        self._note_join_stats(ex, logid)
+        return out
+
+    def _note_join_stats(self, ex, logid: int) -> None:
+        """Mirror the join executor's probe-dispatch counter into the
+        per-stream metrics registry (delta since the last call)."""
+        js = getattr(ex, "join_stats", None)
+        if js is None:
+            return
+        cur = js.get("probe_dispatches", 0)
+        delta = cur - self._join_probe_seen
+        if delta > 0:
+            self._join_probe_seen = cur
+            self._note_decode("join_probe_dispatches", logid, delta)
+
     def _drain_pipe(self) -> None:
         """Pipeline barrier: every submitted batch processed, rows sunk."""
         with self.state_lock:  # _pipe is guarded (hstream-analyze)
@@ -818,6 +856,19 @@ class QueryTask(threading.Thread):
             if rows:
                 with trace_span(self.tracer, "emit"):
                     self.sink(rows)
+
+
+def _plain_columns(cols: dict) -> dict:
+    """Decoded payload columns (kind, arr, dict) -> plain numpy arrays
+    for the join's columnar ingest: string columns gather through their
+    payload dictionary (one vectorized fancy-index, no per-row Python)."""
+    out = {}
+    for name, (kind, arr, d) in cols.items():
+        if kind == "str":
+            out[name] = np.asarray(d, object)[arr]
+        else:
+            out[name] = arr
+    return out
 
 
 def _sample_rows(ts: "np.ndarray", cols: dict,
@@ -1043,7 +1094,15 @@ def stream_sink(ctx, sink_stream: str,
     use_async = hasattr(ctx.store, "append_async")
     pending: list = []
 
+    stats = getattr(ctx, "stats", None)
+
     def sink(rows: list[dict[str, Any]]) -> None:
+        if stats is not None and isinstance(rows, columnar.ColumnarEmit):
+            try:
+                stats.stream_stat_add("change_rows_columnar",
+                                      sink_stream, len(rows))
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the emit path
         payloads = None
         if isinstance(rows, columnar.ColumnarEmit) or len(rows) >= 32:
             # steady-state batches of homogeneous flat rows go out as

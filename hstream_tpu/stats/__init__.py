@@ -33,6 +33,10 @@ PER_STREAM_COUNTERS = [
     "json_decode_native",      # JSON records through libjsondec batch dec
     "json_decode_fallback",    # JSON records through the Python per-record
                                # decode (no toolchain, or CLS_PY rows)
+    "join_probe_dispatches",   # device interval-join probe kernel launches
+                               # (contract: one per join micro-batch)
+    "change_rows_columnar",    # emitted aggregate rows that reached the
+                               # sink as a ColumnarEmit batch (no dicts)
 ]
 
 PER_STREAM_TIME_SERIES = [

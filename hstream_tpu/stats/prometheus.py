@@ -43,6 +43,10 @@ _HELP = {
                           "decoder (libjsondec)",
     "json_decode_fallback": "JSON records decoded by the per-record "
                             "Python fallback",
+    "join_probe_dispatches": "device interval-join probe dispatches "
+                             "(one per join micro-batch)",
+    "change_rows_columnar": "emitted aggregate rows that reached the "
+                            "sink columnar (no per-row dicts)",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",

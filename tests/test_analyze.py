@@ -303,6 +303,64 @@ def test_purity_shard_map_attribute_store():
     assert len(out) == 1 and "self.calls" in out[0].message
 
 
+def test_purity_join_probe_kernel_shapes():
+    """The interval-join kernel builders' shape — closures returning a
+    decorated @jax.jit kernel from a factory — must be in the purity
+    pass's scope: an impure probe/evict kernel is flagged, the clean
+    twin (the real lattice.join_probe_insert / join_evict shape) is
+    not."""
+    bad = '''
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    def join_probe_insert(cap, bcap, match_cap, nm, no):
+        @jax.jit
+        def probe_insert(mine, other, batch, n, within, cutoff):
+            t = time.time()  # trace-frozen wall clock
+            return mine, batch + t
+
+        return probe_insert
+
+    def join_evict(cap, nl, nr):
+        hits = []
+
+        @jax.jit
+        def evict(left, right, cutoff, delta):
+            hits.append(cutoff)  # trace-time mutation
+            return left, right
+
+        return evict
+    '''
+    out = run_one(purity, [src("m.py", bad)])
+    assert rules_of(out) == {"jax-impure"}
+    assert len(out) == 2
+    assert any("probe_insert" in f.message for f in out)
+    assert any("evict" in f.message for f in out)
+
+    clean = '''
+    import jax
+    import jax.numpy as jnp
+
+    def join_probe_insert(cap, bcap, match_cap, nm, no):
+        @jax.jit
+        def probe_insert(mine, other, batch, n, within, cutoff):
+            order = jnp.argsort(batch[0])
+            return mine, batch[:, order]
+
+        return probe_insert
+
+    def join_evict(cap, nl, nr):
+        @jax.jit
+        def evict(left, right, cutoff, delta):
+            alive = left["ts"] >= cutoff
+            return left, right, jnp.sum(alive)
+
+        return evict
+    '''
+    assert run_one(purity, [src("m.py", clean)]) == []
+
+
 def test_purity_donated_reuse():
     code = '''
     import numpy as np
