@@ -101,3 +101,111 @@ def jax_profiler(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---- recompile guard (ISSUE 7) ----------------------------------------------
+#
+# The hot-path contracts (one fused dispatch per cycle, pow2-padded
+# shapes sharing compiled programs, lru_cache'd kernel factories) all
+# cash out as ONE observable: steady-state batches compile ZERO new XLA
+# executables. The static passes (tools/analyze: dispatch/retrace)
+# check the idioms; RetraceGuard checks the outcome at runtime by
+# counting backend compiles via jax.monitoring — the
+# '/jax/core/compile/backend_compile_duration' event fires exactly once
+# per executable build (incl. the tiny utility jits jnp allocations
+# create, which steady loops must also not re-trigger).
+#
+# One process-wide listener is registered lazily and dispatches to
+# every active guard plus the optional stats sink — jax.monitoring has
+# no unregister, so guards attach/detach through the module-level set
+# instead of the listener itself.
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_active_guards: set["RetraceGuard"] = set()
+_guard_lock = threading.Lock()
+# weakrefs: a ServerContext torn down mid-process (tests spin up many)
+# must not be kept alive by the process-wide listener
+_stats_sinks: list[tuple[object, str]] = []  # (weakref to holder, stream)
+_listener_installed = False
+
+
+def _ensure_compile_listener() -> None:
+    global _listener_installed
+    with _guard_lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            if event != _COMPILE_EVENT:
+                return
+            with _guard_lock:
+                guards = list(_active_guards)
+                sinks = list(_stats_sinks)
+            for g in guards:
+                g._bump()
+            dead = []
+            for ref, stream in sinks:
+                stats = ref()
+                if stats is None:
+                    dead.append((ref, stream))
+                    continue
+                try:
+                    stats.stream_stat_add("kernel_recompiles", stream)
+                except Exception:  # noqa: BLE001 — monitoring must
+                    pass           # never break a compile
+            if dead:
+                with _guard_lock:
+                    for ent in dead:
+                        if ent in _stats_sinks:
+                            _stats_sinks.remove(ent)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _listener_installed = True
+
+
+def install_recompile_counter(stats, stream: str = "_process") -> None:
+    """Bump the `kernel_recompiles` per-stream counter on every XLA
+    compile in this process — the /metrics face of the retrace
+    contract. Idempotent per (holder, stream)."""
+    import weakref
+
+    _ensure_compile_listener()
+    with _guard_lock:
+        if not any(ref() is stats and s == stream
+                   for ref, s in _stats_sinks):
+            _stats_sinks.append((weakref.ref(stats), stream))
+
+
+class RetraceGuard:
+    """Counts XLA executable builds while active.
+
+    Usage (tests, bench):
+
+        with RetraceGuard() as g:
+            for batch in batches:
+                ex.process_columnar(...)
+        assert g.count == 0   # steady state must not recompile
+
+    `count` is exact: one per backend compile anywhere in the process
+    while the guard is active (guards are process-global, like the
+    compiles they observe — do not run two guarded regions
+    concurrently and expect per-region attribution)."""
+
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def _bump(self) -> None:
+        with self._lock:
+            self.count += 1
+
+    def __enter__(self) -> "RetraceGuard":
+        _ensure_compile_listener()
+        with _guard_lock:
+            _active_guards.add(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _guard_lock:
+            _active_guards.discard(self)

@@ -308,6 +308,7 @@ class QueryExecutor:
 
         return counted
 
+    # contract: dispatches<=1 fetches<=0
     def _run_step(self, cap: int, n: int, key_ids, ts_rel, cols,
                   valid, null_streams, wm_rel) -> None:
         """Encode one micro-batch with the v2 wire codec and dispatch the
@@ -715,6 +716,9 @@ class QueryExecutor:
         if wait is not None and not wait.is_deleted():
             t0 = time.perf_counter()
             try:
+                # deliberate double-buffer backpressure: bounds in-flight
+                # H2D to upload_slots, blocking an encode worker only.
+                # analyze: ok dispatch-sync — never the step thread
                 wait.block_until_ready()
             except RuntimeError:
                 pass  # donated to a step between the check and the wait
@@ -743,14 +747,17 @@ class QueryExecutor:
                 valid = ~fm
         return valid, null_streams
 
+    # contract: dispatches<=0 fetches<=0
     def stage_columnar(self, key_ids, ts_ms, cols, nulls=None,
                        upload: bool = True) -> StagedBatch | None:
         """Encode (and upload) one micro-batch ahead of its step — safe to
         run on an encoder thread while the main thread dispatches earlier
         batches, as long as stage calls happen in batch order (the wire
-        codec's adaptive state is ordered). Rare control flow (epoch
-        rebase, int32 overflow, gap splits) falls back to the synchronous
-        path inside process_staged()."""
+        codec's adaptive state is ordered). Staging must stay kernel-
+        dispatch- and fetch-FREE (the contract above): it overlaps the
+        ordered step loop, and a sync here would serialize the pipeline.
+        Rare control flow (epoch rebase, int32 overflow, gap splits)
+        falls back to the synchronous path inside process_staged()."""
         key_ids = np.asarray(key_ids, dtype=np.int32)
         n = len(key_ids)
         if n == 0:
@@ -801,6 +808,7 @@ class QueryExecutor:
             self._no_close.clear()
             self._touched_this_call.clear()
 
+    # contract: dispatches<=1 fetches<=0
     def _process_staged(self, staged: StagedBatch) -> list[dict[str, Any]]:
         ts_list = staged.ts_ms
         batch_starts = None
@@ -816,6 +824,9 @@ class QueryExecutor:
             if guarded is not None:
                 return guarded
 
+        # process_staged routes any batch with ts_max - epoch >=
+        # rebase_threshold (< 2^31) to the guarded synchronous path.
+        # analyze: ok overflow-narrowing — caller-guarded narrow
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
         step = lattice.compiled_encoded_step(
@@ -888,6 +899,7 @@ class QueryExecutor:
             row["winEnd"] = win_start_abs + self.window.size_ms
         return self._postprocess(row)
 
+    # contract: dispatches<=1 fetches<=1
     def _drain_changes(self) -> "ColumnarEmit | list[dict[str, Any]]":
         self.state, packed = self._extract_touched(self.state)
         if not self.defer_change_decode:
@@ -953,6 +965,7 @@ class QueryExecutor:
         drain) still hold undelivered rows."""
         return bool(self._pending_changes or self._drain_futs)
 
+    # contract: dispatches<=0 fetches<=1
     def _decode_pending(self, pending: list
                         ) -> "ColumnarEmit | list[dict[str, Any]]":
         """Decode deferred change extracts, fetching device buffers in
@@ -970,7 +983,8 @@ class QueryExecutor:
         for ep, buf in pending:
             by_shape.setdefault(tuple(buf.shape), []).append((ep, buf))
         for group in by_shape.values():
-            stacked = np.asarray(jnp.stack([b for _, b in group]))
+            stacked = np.asarray(lattice.stack_pow2(
+                [b for _, b in group]))
             for (ep, _), buf in zip(group, stacked):
                 rows = extend_rows(rows, self._decode_changes(buf, ep))
         return rows if rows is not None else []
@@ -1036,6 +1050,7 @@ class QueryExecutor:
         out[:len(slots)] = slots
         return out
 
+    # contract: dispatches<=1 fetches<=1
     def _close_windows(self, starts: list[int]) -> list[dict[str, Any]]:
         """Pop + close every window in `starts` with ONE fused
         extract+reset dispatch (the close-cycle contract: one lattice
@@ -1065,6 +1080,7 @@ class QueryExecutor:
             self._no_close.discard(s)
         return rows
 
+    # contract: dispatches<=0 fetches<=1
     def drain_closed(self) -> list[dict[str, Any]]:
         """Decode every deferred window close (forces the device queue).
         Multiple pending close cycles fetch in ONE device->host transfer
@@ -1089,7 +1105,8 @@ class QueryExecutor:
                 (starts, packed))
         for group in by_shape.values():
             self.close_stats["close_fetches"] += 1
-            stacked = np.asarray(jnp.stack([p for _, p in group]))
+            stacked = np.asarray(lattice.stack_pow2(
+                [p for _, p in group]))
             for (starts, _), packed in zip(group, stacked):
                 out = extend_rows(
                     out, self._decode_extract_batch(packed, starts))
@@ -1202,6 +1219,7 @@ class QueryExecutor:
 
     # ---- pull queries (materialized views) ---------------------------------
 
+    # contract: dispatches<=1 fetches<=1
     def peek(self) -> list[dict[str, Any]]:
         """Current (open-window) aggregate rows without resetting state —
         the live half of a materialized view; closed windows are kept by
@@ -1218,5 +1236,6 @@ class QueryExecutor:
         packed = np.asarray(self._extract_slots(self.state, slots))
         return self._decode_extract_batch(packed, starts)
 
+    # contract: dispatches<=0 fetches<=1
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.state)

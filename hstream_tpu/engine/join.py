@@ -283,6 +283,7 @@ class _JoinBase:
             return []
         return self._inner.close_due_windows()
 
+    # contract: dispatches<=0 fetches<=1
     def block_until_ready(self) -> None:
         if self._inner is not None and hasattr(self._inner,
                                                "block_until_ready"):
@@ -681,19 +682,29 @@ class JoinExecutor(_JoinBase):
                 out[i] = -1 if k is None else code_of(k)
         return out
 
+    # contract: dispatches<=0 fetches<=1
     def _compact_codes(self) -> None:
         """Code-space compaction: keep only codes still live in either
         store (retention bounds them), reassign dense codes in sorted
-        order (store order is preserved), remap stores + lut + dict."""
+        order (store order is preserved), remap stores + lut + dict.
+
+        Device mode fetches BOTH sides' code planes in one stacked
+        transfer (they share cap): hstream-analyze's dispatch pass
+        caught the original per-side fetch loop — two round trips on
+        the ingest path every time the code space filled."""
         parts = [self._stores["l"].code, self._stores["r"].code]
         if self._dev is not None:
             self._refresh_counts()
-            for s in ("l", "r"):
-                n = self._dev["n"][s]
-                if n:
-                    parts.append(np.asarray(
-                        self._dev["stores"][s]["code"])[:n]
-                        .astype(np.int64))
+            if self._dev["n"]["l"] or self._dev["n"]["r"]:
+                import jax.numpy as jnp
+
+                codes = np.asarray(jnp.stack(
+                    [self._dev["stores"]["l"]["code"],
+                     self._dev["stores"]["r"]["code"]]))
+                for i, s in enumerate(("l", "r")):
+                    n = self._dev["n"][s]
+                    if n:
+                        parts.append(codes[i, :n].astype(np.int64))
         live = np.union1d(parts[0], np.concatenate(parts[1:])
                           if len(parts) > 1 else parts[0])
         new_of_old = np.full(len(self._jcode_rev), -1, np.int64)
@@ -774,13 +785,13 @@ class JoinExecutor(_JoinBase):
     def flush_staged(self) -> list[dict[str, Any]]:
         """Step the inner executor with every lagging match: deferred
         device match buffers fetch + decode first (they may stage into
-        the coalesce buffer), then every coalesced row steps."""
-        out = self._drain_matches() if self._pending_matches else []
-        rows = self._drain_staged(keep_tail=False)
-        if not out:
-            return rows
-        out.extend(rows)
-        return out
+        the coalesce buffer), then every coalesced row steps. A lone
+        columnar batch from either half stays a ColumnarEmit."""
+        from hstream_tpu.common.columnar import extend_rows
+
+        out = self._drain_matches() if self._pending_matches else None
+        out = extend_rows(out, self._drain_staged(keep_tail=False))
+        return out if out is not None else []
 
     def _drain_staged(self, *, keep_tail: bool) -> list[dict[str, Any]]:
         """Step coalesced matches. keep_tail=True steps only whole
@@ -1097,6 +1108,14 @@ class JoinExecutor(_JoinBase):
         if n == 0:
             return
         dev = self._dev
+        if int(st.ts.max()) - dev["t0"] >= (1 << 31):
+            # the host store's span guard allows 2^41 ms but the device
+            # store's relative space is int32: a silent wrap here would
+            # corrupt every probe bound (found by hstream-analyze,
+            # overflow-narrowing)
+            raise SQLCodegenError(
+                "join store spans more than the int32 relative range "
+                "at device activation; reduce within/grace retention")
         dev["shadow"][side].insert_sorted(
             st.code.copy(), st.ts.copy(), np.empty(n, object))
         lay = dev["lay"][side]
@@ -1367,6 +1386,7 @@ class JoinExecutor(_JoinBase):
         caps.add(cap)
         return cap
 
+    # contract: dispatches<=1 fetches<=0
     def _device_batch(self, side, codes, bts, flags, vals
                       ) -> list[dict[str, Any]]:
         """One micro-batch on the device path: pack, ONE device
@@ -1495,6 +1515,7 @@ class JoinExecutor(_JoinBase):
             by_res[r] = s
         return True
 
+    # contract: dispatches<=1 fetches<=0
     def _fused_batch(self, side, other_side, buf, n, cutoff
                      ) -> list[dict[str, Any]]:
         """Dispatch the probe+insert+inner-scatter kernel: the matched
@@ -1543,17 +1564,20 @@ class JoinExecutor(_JoinBase):
             if inner.emit_changes:
                 out = extend_rows(out, inner._drain_changes())
             out = extend_rows(out, inner.close_due_windows())
-            return list(out) if out is not None else []
+            # a lone ColumnarEmit rides through unmaterialized — the
+            # fused path must not be the one place rows re-dictify
+            return out if out is not None else []
         finally:
             inner._no_close.clear()
             inner._touched_this_call.clear()
 
+    # contract: dispatches<=0 fetches<=1
     def _drain_matches(self) -> list[dict[str, Any]]:
         """Fetch + decode every pending match buffer: buffers of one
         shape stack into ONE device->host transfer (fetch count, not
         bytes, dominates on real links), then decode columnar and feed
         the inner executor."""
-        import jax.numpy as jnp
+        from hstream_tpu.engine.lattice import stack_pow2
 
         if not self._pending_matches:
             return []
@@ -1575,21 +1599,25 @@ class JoinExecutor(_JoinBase):
             groups: dict[int, tuple] = {}
             for group in by_shape.values():
                 self.join_stats["probe_fetches"] += 1
-                stacked = np.asarray(jnp.stack([e[0] for e in group]))
+                stacked = np.asarray(stack_pow2([e[0] for e in group]))
                 for ent, hbuf in zip(group, stacked):
                     groups[id(ent)] = (hbuf, *ent[1:])
             # preserve submission order across shape groups
             host = [groups[id(ent)] for ent in pending]
-        out: list[dict[str, Any]] = []
+        from hstream_tpu.common.columnar import extend_rows
+
+        out = None
         for hbuf, side, t0, buf, n, other, cutoff in host:
             nm = len(self._dev["lay"][side])
             total = int(hbuf[0, 0])
             if total > hbuf.shape[1]:
                 hbuf = self._reprobe_wider(side, buf, n, other, cutoff,
                                            total)
-            out.extend(self._decode_matches(side, t0, hbuf, nm) or [])
-        return out
+            out = extend_rows(out, self._decode_matches(side, t0, hbuf,
+                                                        nm))
+        return out if out is not None else []
 
+    # contract: dispatches<=1 fetches<=1
     def _reprobe_wider(self, side, buf, n, other, cutoff,
                        total) -> np.ndarray:
         """Match-overflow redo: probe-only at the next pow2 width (the
@@ -1708,6 +1736,7 @@ class JoinExecutor(_JoinBase):
             return
         self._dispatch_evict(cutoff_abs, 0)
 
+    # contract: dispatches<=1 fetches<=0
     def _dispatch_evict(self, cutoff_abs: int, delta: int) -> None:
         """One vmapped two-sided eviction (+ rebase) dispatch. The live
         counts stay a DEVICE value (dev["pending_n"]) so the hot loop
@@ -1744,6 +1773,7 @@ class JoinExecutor(_JoinBase):
         if pend is not None:
             pend[1][side] += n
 
+    # contract: dispatches<=0 fetches<=1
     def _refresh_counts(self) -> None:
         """Force the deferred post-eviction live counts (2-int fetch),
         re-adding inserts dispatched after the eviction."""
@@ -1826,6 +1856,9 @@ class JoinExecutor(_JoinBase):
             st = _FlatIntervalStore(self._jcode_rev)
             n = self._dev["n"][side]
             if n:
+                # snapshot serialization, off the hot loop; the sides
+                # differ in column layout so their fetches cannot stack.
+                # analyze: ok dispatch-sync — rare, host-driven
                 arrs = {k: np.asarray(v) for k, v in jax.device_get(
                     self._dev["stores"][side]).items()}
                 if cutoff is not None:

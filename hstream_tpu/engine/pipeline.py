@@ -8,8 +8,12 @@ The executor's hot loop has three host-side phases per micro-batch:
   3. jitted step dispatch + window bookkeeping + change drain
 Phases 1-2 are pure w.r.t. engine state (the wire codec's adaptive
 state tolerates out-of-order planning — every batch's combo/bases/words
-triple is self-consistent; see transport.BitpackTransport), so N encode
-workers overlap with the ordered step dispatches of earlier batches:
+triple is self-consistent; see transport.BitpackTransport) AND kernel-
+dispatch/fetch-free (executor.stage_columnar declares `# contract:
+dispatches<=0 fetches<=0`, checked by the tools/analyze dispatch pass
+— a sync on a worker thread would serialize the overlap this pipeline
+exists for), so N encode workers overlap with the ordered step
+dispatches of earlier batches:
 batch i+2 encodes on one worker while batch i+1's upload rides the link
 and batch i's scatter runs on the device. Order is restored by sequence
 tags: workers deposit staged batches into a reorder ring and the caller

@@ -82,6 +82,9 @@ class ShardedQueryExecutor(QueryExecutor):
         new_k = self.spec.n_keys * 2
         kinds = se_lattice.plane_merge_kinds(self.spec)
         extra = new_k - self.spec.n_keys
+        # key growth re-shards through the host: one fetch per plane is
+        # unavoidable (mixed dtypes/ranks cannot stack).
+        # analyze: ok dispatch-sync — rare re-shard path by design
         host = {k: np.asarray(v) for k, v in self.state.items()}
         grown = {}
         for k, v in host.items():
@@ -123,6 +126,7 @@ class ShardedQueryExecutor(QueryExecutor):
             epoch=0, ts_min=int(ts.min()), ts_max=int(ts.max()),
             key_ids=key_ids, ts_ms=ts, cols=cols, nulls=nulls)
 
+    # contract: dispatches<=1 fetches<=0
     def _run_step(self, cap, n, key_ids, ts_rel, cols, valid,
                   null_streams, wm_rel) -> None:
         # The sharded path keeps the v1 packed transport: the batch is
@@ -131,10 +135,14 @@ class ShardedQueryExecutor(QueryExecutor):
         # bit-packed link codec.
         null_masks = [null_streams.get(nk) for nk, _ in self._null_specs]
         packed = se_lattice.pack_batch_host(
-            cap, n, key_ids, np.asarray(ts_rel).astype(np.int32), valid,
+            cap, n, key_ids,
+            # both callers narrow ts_rel only after their own span check
+            # analyze: ok overflow-narrowing — caller-guarded narrow
+            np.asarray(ts_rel, dtype=np.int32), valid,
             cols, null_masks, self._layout)
         self.state = self._step(self.state, wm_rel, packed)
 
+    # contract: dispatches<=1 fetches<=1
     def _drain_changes(self):
         """Columnar sharded changelog drain: ONE host fetch of the
         per-key-shard packed buffers, then the same batched decode the

@@ -25,6 +25,12 @@ view peek — amortized over the window length.
 This mirrors the scaling-book recipe: pick a mesh, annotate shardings, let
 the compiled collectives ride ICI. DCN never sees lattice traffic; it is
 reserved for the log-store replication plane (hstream_tpu.store).
+
+The shard_map hygiene here (collectives only inside mesh bodies, no
+host callbacks/fetches in them, axis names spelled consistently) is
+checked by the tools/analyze shardmap pass — the CI jax build lacks
+shard_map entirely, so these mistakes would otherwise surface only on
+real mesh hardware.
 """
 
 from __future__ import annotations

@@ -69,6 +69,12 @@ class ServerContext:
         from hstream_tpu.store.versioned import VersionedConfigStore
 
         self.stats = StatsHolder()
+        # runtime face of the retrace contract (ISSUE 7): every XLA
+        # compile in this process bumps kernel_recompiles, so a
+        # steady-state recompile regression is visible on /metrics
+        from hstream_tpu.common.tracing import install_recompile_counter
+
+        install_recompile_counter(self.stats)
         # observability plane: structured event journal + the slow-
         # request threshold handlers log correlated warnings above
         self.events = EventJournal()

@@ -37,6 +37,9 @@ PER_STREAM_COUNTERS = [
                                # (contract: one per join micro-batch)
     "change_rows_columnar",    # emitted aggregate rows that reached the
                                # sink as a ColumnarEmit batch (no dicts)
+    "kernel_recompiles",       # XLA executable builds observed by the
+                               # process-wide RetraceGuard listener
+                               # (contract: zero in steady state)
 ]
 
 PER_STREAM_TIME_SERIES = [

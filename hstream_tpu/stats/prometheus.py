@@ -47,6 +47,8 @@ _HELP = {
                              "(one per join micro-batch)",
     "change_rows_columnar": "emitted aggregate rows that reached the "
                             "sink columnar (no per-row dicts)",
+    "kernel_recompiles": "XLA executable builds observed at runtime "
+                         "(zero in steady state)",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",
@@ -106,7 +108,12 @@ def render_holder(stats, *, live_streams=None) -> str:
             if metric.endswith("_total") else f"{PREFIX}_{metric}_total"
         _header(lines, name, "counter", metric)
         for stream, v in sorted(stats.stream_stat_getall(metric).items()):
-            if live_streams is not None and stream not in live_streams:
+            # "_"-prefixed labels are process-scoped pseudo-streams
+            # (kernel_recompiles{stream="_process"}): they are not in
+            # the stream namespace, so the liveness filter must not
+            # drop them
+            if (live_streams is not None and stream not in live_streams
+                    and not stream.startswith("_")):
                 continue
             lines.append(_series(name, {"stream": stream}, v))
     for metric, _levels in PER_STREAM_TIME_SERIES:

@@ -29,11 +29,15 @@ from tools.analyze import (  # noqa: E402
 )
 from tools.analyze.passes import (  # noqa: E402
     blocking,
+    dispatch,
     errcontract,
     lifecycle,
     locks,
+    overflow,
     purity,
     registry,
+    retrace,
+    shardmap,
 )
 
 
@@ -586,6 +590,512 @@ def test_registry_dead_entry_flagged():
     out = run_one(registry, [src("hstream_tpu/fixture.py", "x = 1\n")])
     dead = [f for f in out if f.rule == "registry-dead"]
     assert any("append_total" in f.message for f in dead)
+
+
+# ---- dispatch (ISSUE 7) ----------------------------------------------------
+
+
+HOT = "hstream_tpu/engine/executor.py"  # a dispatch-sync hot-path rel
+
+
+def test_dispatch_fetch_in_loop_blows_budget():
+    """The canonical regression: a fetch per window inside a contract
+    function — the exact shape the fused close exists to prevent."""
+    code = '''
+    import numpy as np
+    from hstream_tpu.engine import lattice
+
+    class Ex:
+        def _compile(self):
+            fns = lattice.compiled(self.spec)
+            self._extract_touched = fns.extract_touched
+
+        # contract: dispatches<=1 fetches<=1
+        def drain(self):
+            state, packed = self._extract_touched(self.state)
+            out = []
+            for w in self.windows:
+                out.append(np.asarray(packed[w]))
+            return out
+    '''
+    out = run_one(dispatch, [src("m.py", code)])
+    assert rules_of(out) == {"dispatch-budget"}
+    (f,) = out
+    assert "loop" in f.message and "self.windows" in f.message
+
+
+def test_dispatch_static_count_exceeds_budget():
+    code = '''
+    import numpy as np
+    from hstream_tpu.engine import lattice
+
+    class Ex:
+        def _compile(self):
+            fns = lattice.compiled(self.spec)
+            self._extract_touched = fns.extract_touched
+
+        # contract: dispatches<=1 fetches<=1
+        def close(self):
+            s1 = self._extract_touched(self.state)
+            s2 = self._extract_touched(self.state)
+            return np.asarray(s1), np.asarray(s2)
+    '''
+    out = run_one(dispatch, [src("m.py", code)])
+    assert len(out) == 2  # dispatches AND fetches exceeded
+    assert all(f.rule == "dispatch-budget" for f in out)
+    assert any("dispatch site(s)" in f.message for f in out)
+    assert any("fetch site(s)" in f.message for f in out)
+
+
+def test_dispatch_shape_group_stacking_and_branches_clean():
+    """The repo's real drain shape — early-return branches take the
+    max, the by_shape stacking loop is the sanctioned ONE-fetch-per-
+    compiled-shape idiom — fits dispatches<=1 fetches<=1."""
+    code = '''
+    import jax.numpy as jnp
+    import numpy as np
+
+    class Ex:
+        # contract: dispatches<=0 fetches<=1
+        def drain_closed(self):
+            if not self._pending:
+                return []
+            if len(self._pending) == 1:
+                return np.asarray(self._pending[0])
+            by_shape = {}
+            for starts, packed in self._pending:
+                by_shape.setdefault(packed.shape, []).append(packed)
+            out = []
+            for group in by_shape.values():
+                out.append(np.asarray(jnp.stack(group)))
+            return out
+    '''
+    assert run_one(dispatch, [src("m.py", code)]) == []
+
+
+def test_dispatch_sync_in_hot_path_flagged_and_contract_exempts():
+    bare = '''
+    import numpy as np
+
+    class Ex:
+        def hot(self):
+            return np.asarray(self.state["count"])
+    '''
+    out = run_one(dispatch, [src(HOT, bare)])
+    assert len(out) == 1 and out[0].rule == "dispatch-sync"
+    # the same sync under a declared budget is sanctioned + checked
+    annotated = bare.replace("        def hot(self):",
+                             "        # contract: fetches<=1\n"
+                             "        def hot(self):")
+    assert run_one(dispatch, [src(HOT, annotated)]) == []
+    # and outside the kernel/executor layer it is not policed
+    assert run_one(dispatch, [src("hstream_tpu/server/x.py", bare)]) \
+        == []
+
+
+def test_dispatch_host_typed_asarray_not_a_fetch():
+    code = '''
+    import numpy as np
+
+    class Ex:
+        def ingest(self, ts_ms):
+            return np.asarray(ts_ms, dtype=np.int64)
+    '''
+    assert run_one(dispatch, [src(HOT, code)]) == []
+
+
+def test_dispatch_contract_syntax_error_flagged():
+    code = '''
+    class Ex:
+        # contract: dispatch<=1
+        def f(self):
+            return 1
+    '''
+    out = run_one(dispatch, [src("m.py", code)])
+    assert len(out) == 1 and out[0].rule == "dispatch-contract-syntax"
+
+
+def test_dispatch_waiver_suppresses():
+    code = '''
+    import numpy as np
+
+    class Ex:
+        def hot(self):
+            # analyze: ok dispatch-sync — test waiver
+            return np.asarray(self.state["count"])
+    '''
+    assert run_one(dispatch, [src(HOT, code)]) == []
+
+
+# ---- retrace (ISSUE 7) -----------------------------------------------------
+
+
+def test_retrace_uncached_jit_flagged():
+    code = '''
+    import jax
+
+    class Ex:
+        def step_batch(self, batch):
+            f = jax.jit(self._step)      # fresh wrapper per call!
+            return f(batch)
+    '''
+    out = run_one(retrace, [src("m.py", code)])
+    assert len(out) == 1 and out[0].rule == "retrace-uncached-jit"
+    assert "step_batch" in out[0].message
+
+
+def test_retrace_factory_shapes_sanctioned():
+    code = '''
+    import functools
+
+    import jax
+
+    @functools.lru_cache(maxsize=64)
+    def compiled_step(cap):
+        @jax.jit
+        def step(state, batch):
+            return state
+
+        return step
+
+    def build_extract(spec):
+        return jax.jit(lambda s: s)
+
+    @jax.jit
+    def rebase(state, delta):
+        return state
+    '''
+    assert run_one(retrace, [src("m.py", code)]) == []
+
+
+def test_retrace_traced_branch_flagged_none_test_exempt():
+    bad = '''
+    import jax
+
+    @jax.jit
+    def step(x, n):
+        if n > 0:
+            return x + n
+        return x
+    '''
+    out = run_one(retrace, [src("m.py", bad)])
+    assert len(out) == 1 and out[0].rule == "retrace-traced-branch"
+    assert "'n'" in out[0].message
+
+    ok = '''
+    import jax
+
+    @jax.jit
+    def step(x, mask=None):
+        if mask is None:
+            return x
+        return x * mask
+    '''
+    assert run_one(retrace, [src("m.py", ok)]) == []
+
+
+def test_retrace_float_static_arg_flagged():
+    code = '''
+    import jax
+
+    def step(state, rate=0.5):
+        return state * rate
+
+    compiled = jax.jit(step, static_argnums=(1,))
+    '''
+    out = run_one(retrace, [src("m.py", code)])
+    assert len(out) == 1 and out[0].rule == "retrace-static-arg"
+    assert "rate" in out[0].message
+
+
+def test_retrace_raw_len_shape_key_flagged():
+    bad = '''
+    from hstream_tpu.engine import lattice
+
+    def probe(batch, dev):
+        kern = lattice.join_probe_insert(
+            dev["cap"], len(batch), dev["match_cap"], 2, 2)
+        return kern
+    '''
+    out = run_one(retrace, [src("m.py", bad)])
+    assert len(out) == 1 and out[0].rule == "retrace-shape-key"
+    ok = bad.replace("len(batch)", "bcap")
+    assert run_one(retrace, [src("m.py", ok)]) == []
+
+
+# ---- overflow (ISSUE 7) ----------------------------------------------------
+
+
+def test_overflow_arith_on_int32_cast_ts():
+    """The seeded 'raw int32 ts arithmetic' violation: narrowing
+    BEFORE subtracting wraps before any guard can fire."""
+    code = '''
+    import numpy as np
+
+    class Ex:
+        def ingest(self, ts_ms):
+            rel = np.asarray(ts_ms).astype(np.int32) - self.epoch
+            return rel
+    '''
+    out = run_one(overflow, [src("m.py", code)])
+    assert rules_of(out) == {"overflow-ts-arith"}
+
+
+def test_overflow_unguarded_narrow_flagged_guarded_clean():
+    bad = '''
+    import numpy as np
+
+    class Ex:
+        def wm(self):
+            return np.int32(self.watermark_abs - self.epoch)
+    '''
+    out = run_one(overflow, [src("m.py", bad)])
+    assert rules_of(out) == {"overflow-narrowing"}
+
+    guarded = '''
+    import numpy as np
+
+    class Ex:
+        def wm(self):
+            rel = self.watermark_abs - self.epoch
+            if rel >= (1 << 31):
+                raise OverflowError("span")
+            return np.int32(rel)
+    '''
+    assert run_one(overflow, [src("m.py", guarded)]) == []
+
+
+def test_overflow_rebase_call_counts_as_guard():
+    code = '''
+    import numpy as np
+
+    class Ex:
+        def ingest(self, bts):
+            self._maybe_rebase(int(bts.min()), int(bts.max()))
+            return (bts - self.t0).astype(np.int32)
+    '''
+    assert run_one(overflow, [src("m.py", code)]) == []
+
+
+def test_overflow_device_code_exempt():
+    """Jitted kernels (and helpers they call) compute in the rebased
+    int32 space by design — the host guards the boundary."""
+    code = '''
+    import jax
+    import jax.numpy as jnp
+
+    def pack_rows(count, win_start):
+        return jnp.broadcast_to(jnp.asarray(win_start, jnp.int32),
+                                count.shape)
+
+    def build_extract(spec):
+        @jax.jit
+        def extract(state, slot):
+            ts32 = state["ts"].astype(jnp.int32)
+            return pack_rows(state["count"], ts32)
+
+        return extract
+    '''
+    assert run_one(overflow, [src("m.py", code)]) == []
+
+
+def test_overflow_non_time_names_not_matched():
+    code = '''
+    import numpy as np
+
+    def shape_stats(counts):
+        return counts.astype(np.int32)
+    '''
+    assert run_one(overflow, [src("m.py", code)]) == []
+
+
+# ---- shardmap (ISSUE 7) ----------------------------------------------------
+
+
+SHARD_CLEAN = '''
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+def build(mesh, data_axis="data"):
+    def merged(state):
+        return jax.lax.psum(state, data_axis)
+
+    def step_local(state, batch):
+        shard = jax.lax.axis_index(data_axis)
+        return merged(state) + shard
+
+    return jax.jit(jax.shard_map(step_local, mesh=mesh))
+'''
+
+
+def test_shardmap_clean_bodies_pass():
+    assert run_one(shardmap, [src("m.py", SHARD_CLEAN)]) == []
+
+
+def test_shardmap_callback_in_body_flagged():
+    """The seeded callback-in-shard_map violation."""
+    code = SHARD_CLEAN.replace(
+        "        shard = jax.lax.axis_index(data_axis)",
+        "        shard = jax.lax.axis_index(data_axis)\n"
+        "        jax.debug.print(\"shard {s}\", s=shard)")
+    out = run_one(shardmap, [src("m.py", code)])
+    assert rules_of(out) == {"shardmap-callback"}
+    assert "jax.debug.print" in out[0].message
+
+
+def test_shardmap_host_fetch_in_body_flagged():
+    code = SHARD_CLEAN.replace(
+        "        return merged(state) + shard",
+        "        import numpy as np\n"
+        "        return np.asarray(merged(state)) + shard")
+    out = run_one(shardmap, [src("m.py", code)])
+    assert rules_of(out) == {"shardmap-callback"}
+    assert "np.asarray" in out[0].message
+
+
+def test_shardmap_collective_outside_body_flagged():
+    code = '''
+    import jax
+
+    def merge_on_host(partials):
+        return jax.lax.psum(partials, "data")
+    '''
+    out = run_one(shardmap, [src("m.py", code)])
+    assert rules_of(out) == {"shardmap-collective"}
+
+
+def test_shardmap_axis_typo_flagged():
+    code = '''
+    import jax
+    from jax.sharding import Mesh
+
+    def build(devices):
+        mesh = Mesh(devices, ("data", "key"))
+
+        def step_local(state):
+            return jax.lax.psum(state, "dta")
+
+        return jax.shard_map(step_local, mesh=mesh)
+    '''
+    out = run_one(shardmap, [src("m.py", code)])
+    assert "shardmap-axis" in rules_of(out)
+    (f,) = [f for f in out if f.rule == "shardmap-axis"]
+    assert "'dta'" in f.message and "data" in f.message
+
+
+# ---- analyze CLI --json ----------------------------------------------------
+
+
+def test_cli_json_output(tmp_path):
+    """--json emits one machine-readable array of the NEW findings."""
+    mini = tmp_path / "mini"
+    (mini / "hstream_tpu").mkdir(parents=True)
+    (mini / "tools").mkdir()
+    (mini / "hstream_tpu" / "box.py").write_text(
+        textwrap.dedent(LOCKED_CLASS.format(waiver="")))
+    (mini / "bench.py").write_text("")
+    base = str(tmp_path / "b.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--only", "locks",
+         "--repo", str(mini), "--baseline", base, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    records = json.loads(r.stdout)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["rule"] == "lock-guard"
+    assert rec["path"] == "hstream_tpu/box.py"
+    assert isinstance(rec["line"], int) and rec["line"] > 0
+    assert "_val" in rec["message"]
+    # a clean tree emits an empty array and exits 0
+    (mini / "hstream_tpu" / "box.py").write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--only", "locks",
+         "--repo", str(mini), "--baseline", base, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0 and json.loads(r.stdout) == []
+
+
+# ---- RetraceGuard: runtime recompile contract (ISSUE 7) --------------------
+
+
+@pytest.fixture
+def retrace_guard():
+    """Context factory asserting ZERO XLA compiles inside the block —
+    the runtime complement of the static retrace pass."""
+    import contextlib
+
+    from hstream_tpu.common.tracing import RetraceGuard
+
+    @contextlib.contextmanager
+    def guard_zero():
+        with RetraceGuard() as g:
+            yield g
+        assert g.count == 0, \
+            f"steady state compiled {g.count} new XLA executable(s)"
+
+    return guard_zero
+
+
+def test_retrace_guard_counts_first_compile():
+    import jax
+    import jax.numpy as jnp
+
+    from hstream_tpu.common.tracing import RetraceGuard
+
+    f = jax.jit(lambda x: x * 3 + 1)
+    with RetraceGuard() as g:
+        f(jnp.zeros(5))
+    assert g.count >= 1  # fresh wrapper: at least its own compile
+    with RetraceGuard() as g2:
+        f(jnp.zeros(5))
+    assert g2.count == 0  # cached executable: no recompile
+
+
+def test_retrace_guard_zero_steady_state_fused_close(retrace_guard):
+    """50 post-warmup fused-close batches compile NOTHING (the
+    acceptance contract; same config the CI smoke gate runs)."""
+    import bench
+
+    ex, feed, warm = bench._smoke_tumbling_config()
+    for i in range(warm):
+        feed(i)
+    ex.block_until_ready()
+    with retrace_guard():
+        for i in range(warm, warm + 50):
+            feed(i)
+        ex.block_until_ready()
+
+
+def test_retrace_guard_zero_steady_state_device_join(retrace_guard):
+    """50 post-warmup device-join micro-batches compile NOTHING."""
+    import bench
+
+    ex, feed, warm = bench._smoke_join_config()
+    for b in range(warm):
+        feed(b)
+    ex.flush_changes()
+    ex.block_until_ready()
+    assert ex._dev is not None, "device join did not activate"
+    with retrace_guard():
+        for b in range(warm, warm + 50):
+            feed(b)
+        ex.flush_changes()
+        ex.block_until_ready()
+
+
+def test_kernel_recompiles_counter_taps_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from hstream_tpu.common.tracing import install_recompile_counter
+    from hstream_tpu.stats import StatsHolder
+
+    stats = StatsHolder()
+    install_recompile_counter(stats, stream="_test")
+    jax.jit(lambda x: x - 7)(jnp.zeros(3))
+    assert stats.stream_stat_get("kernel_recompiles", "_test") >= 1
 
 
 # ---- waivers / baseline / framework ----------------------------------------

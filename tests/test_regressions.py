@@ -581,3 +581,76 @@ def test_retry_policy_honors_classification():
         with pytest.raises(grpc.RpcError):
             pol.call(hard)
         assert attempts["n"] == 1, f"{code} must not retry"
+
+
+# ---- ISSUE 7: defects found by the kernel-contract passes -------------------
+
+
+def test_compact_codes_fetches_once_and_preserves_results():
+    """dispatch-sync fix: device-mode _compact_codes fetched each
+    side's code plane in a per-side loop (two round trips on the
+    ingest path); it now stacks both sides into ONE transfer. The
+    compaction must still remap codes exactly — results after a manual
+    compaction match the host reference run bit-for-bit."""
+    from tests.test_join_device import (
+        final_changes,
+        gen_batches,
+        make_join,
+        run_batches,
+    )
+
+    batches = gen_batches(seed=23, n_batches=10)
+    host = make_join(use_device_join=False)
+    href = final_changes(run_batches(host, batches))
+
+    dev = make_join()
+    out = []
+    for i, (rows, ts, side) in enumerate(batches):
+        out.extend(dev.process(rows, ts, stream=side))
+        if i == 6:
+            assert dev._dev is not None, "device path not active yet"
+            dev._compact_codes()  # forced mid-stream compaction
+    out.extend(dev.flush_changes())
+    assert final_changes(out) == href
+
+
+def test_migrate_store_int32_span_guard():
+    """overflow-narrowing fix: device activation migrates host stores
+    with `(st.ts - t0).astype(np.int32)` — the host store's 2^41 span
+    guard allows ranges int32 cannot hold, so a join whose retention
+    spans > 2^31 ms must fail LOUDLY at activation instead of silently
+    wrapping every probe bound."""
+    from hstream_tpu.common.errors import SQLCodegenError
+    from tests.test_join_device import BASE, make_join
+
+    # WITHIN 30000000s ~ 3e10 ms: retention exceeds int32 range
+    sql = ("SELECT l.k, COUNT(*) AS c, SUM(l.x) AS s FROM l INNER "
+           "JOIN r WITHIN (INTERVAL 30000000 SECOND) ON l.k = r.k "
+           "GROUP BY l.k, TUMBLING (INTERVAL 10 SECOND) "
+           "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
+    ex = make_join(sql=sql)
+    rows = [{"k": "k0", "x": 1.0}]
+    # two resident entries > 2^31 ms apart (both within retention)
+    ex.process(rows, [BASE], stream="l")
+    ex.process(rows, [BASE + (1 << 31) + 500_000], stream="l")
+    # first match builds the inner executor and plans the fast path
+    ex.process(rows, [BASE + (1 << 31) + 600_000], stream="r")
+    with pytest.raises(SQLCodegenError, match="int32"):
+        # the next batch activates the device stores — migration must
+        # fail loudly on the un-narrowable span
+        ex.process(rows, [BASE + (1 << 31) + 700_000], stream="r")
+
+
+def test_measure_rtt_jit_is_memoized():
+    """retrace-uncached-jit fix: bench.measure_rtt built a fresh
+    jax.jit wrapper per call; the kernel now comes from an lru_cache
+    factory, so repeated calls reuse ONE compiled executable."""
+    import bench
+    from hstream_tpu.common.tracing import RetraceGuard
+
+    assert bench._rtt_step() is bench._rtt_step()
+    bench.measure_rtt()  # warm (compiles once)
+    with RetraceGuard() as g:
+        bench.measure_rtt()
+        bench.measure_rtt()
+    assert g.count == 0, "measure_rtt retraced after warmup"

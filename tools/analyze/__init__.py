@@ -16,6 +16,21 @@ tree's own majority idiom, violations flagged in the minority):
                                          jit/shard_map'd fns stay pure;
                                          donated buffers are dead after
                                          the donating call
+  dispatch     dispatch-budget / dispatch-sync
+                                         `# contract: dispatches<=N
+                                         fetches<=M` budgets hold
+                                         statically; no bare device
+                                         syncs in the kernel layer
+  retrace      retrace-*                 jit wrappers are memoized,
+                                         no traced branches, no float/
+                                         unhashable statics, no raw
+                                         len() compile-cache keys
+  overflow     overflow-*                int32 narrows of time/seq
+                                         values are guarded; no arith
+                                         on pre-narrowed timestamps
+  shardmap     shardmap-*                collectives stay inside mesh
+                                         bodies, no host callbacks in
+                                         shard_map, axis names spelled
   errcontract  err-http / err-retry-class / err-dead-retry
                                          gRPC status <-> HTTP mapping <->
                                          client retry classification
@@ -129,15 +144,20 @@ def all_passes() -> dict[str, object]:
     """name -> pass module, in canonical order."""
     from tools.analyze.passes import (
         blocking,
+        dispatch,
         errcontract,
         lifecycle,
         locks,
+        overflow,
         purity,
         registry,
+        retrace,
+        shardmap,
     )
 
     return {m.NAME: m for m in
-            (locks, blocking, purity, errcontract, lifecycle, registry)}
+            (locks, blocking, purity, dispatch, retrace, overflow,
+             shardmap, errcontract, lifecycle, registry)}
 
 
 def load_baseline(path: str = BASELINE_PATH) -> set[tuple[str, str, str]]:
@@ -198,10 +218,15 @@ def main(argv: list[str] | None = None) -> int:
         description="repo-native static analysis (see tools/analyze)")
     ap.add_argument("--only", default=None,
                     help="comma-separated pass names "
-                         "(locks,blocking,purity,errcontract,"
-                         "lifecycle,registry)")
+                         "(locks,blocking,purity,dispatch,retrace,"
+                         "overflow,shardmap,errcontract,lifecycle,"
+                         "registry)")
     ap.add_argument("--stats", action="store_true",
                     help="emit per-rule finding counts (incl. baselined)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit NEW findings as one JSON array of "
+                         "{rule,path,line,message} records (CI "
+                         "annotation tooling); exit code unchanged")
     ap.add_argument("--baseline", default=BASELINE_PATH,
                     help="baseline file (default tools/analyze/"
                          "baseline.json)")
@@ -242,6 +267,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     new = [f for f in findings if f.key() not in baseline]
     grandfathered = len(findings) - len(new)
+
+    if args.json:
+        # machine output only: one array of finding records, so CI
+        # annotators never have to scrape the human report
+        print(json.dumps([{"rule": f.rule, "path": f.path,
+                           "line": f.line, "message": f.message}
+                          for f in new]))
+        return 1 if new else 0
 
     if args.stats:
         counts: dict[str, int] = {}

@@ -1,0 +1,464 @@
+"""Dispatch/fetch budget pass.
+
+The hot-path invariants PRs 5-6 earned by hand — ONE fused kernel
+dispatch and at most ONE device->host fetch per close cycle / join
+micro-batch — are worth ~22x between kernel-only and end-to-end
+throughput, and nothing structural keeps them: one stray `np.asarray`
+in a drain or one per-window fetch loop silently reintroduces a round
+trip per item. This pass makes the budgets declared and checked.
+
+Contract comments bind a budget to a function, on the line directly
+above its `def` (above any decorators) or on the `def` line itself:
+
+    # contract: dispatches<=1 fetches<=1
+    def _close_windows(self, starts): ...
+
+`dispatch-budget` then checks the body statically:
+
+  * a recognized dispatch (call to a compiled-kernel callable) or
+    fetch (device->host sync) inside a `for`/`while` loop blows ANY
+    finite budget — unless the loop is the sanctioned shape-group
+    stacking idiom (iterating a `by_shape` grouping, which fetches
+    once per compiled shape, the repo's batched-drain pattern);
+  * the static call-site count (branch-aware: `if`/`else` arms take
+    the max, early-returning arms split the tail) must fit the budget.
+
+`dispatch-sync` flags device syncs in UNANNOTATED functions of the
+kernel/executor layer: every legitimate drain point carries a contract
+(which both sanctions and budgets it), so a bare sync is either a new
+drain that needs a budget or a hot-path regression.
+
+Recognition (local, per class/module — no whole-program analysis):
+
+  dispatches  calls to names bound from kernel factories — `jax.jit`,
+              `lattice.join_probe_insert/...step/_only`, `join_evict`,
+              `compiled_encoded_step`, `self._count_close_kernel(...)`
+              — directly, or via `self.X = <factory>` anywhere in the
+              class, or via attributes of a `lattice.compiled(...)` /
+              `ShardedLattice(...)` result (`self.X = fns.extract_...`).
+  fetches     `.block_until_ready()`, `jax.device_get`, `.item()`, and
+              `np.asarray(x)` where x is device-derived (assigned from
+              a jnp./jax./kernel call) or named like a device value
+              (packed/buf/words/state/dev/stacked...). Inside a
+              contract function every bare `np.asarray(name)` without
+              a dtype counts — contract paths are device paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analyze import Finding
+from tools.analyze.passes import call_name, dotted
+
+NAME = "dispatch"
+
+RULES = {
+    "dispatch-budget": (
+        "function declaring `# contract: dispatches<=N fetches<=M` "
+        "exceeds it statically — a kernel dispatch or device fetch in "
+        "an unsanctioned loop, or more call sites than the budget"),
+    "dispatch-sync": (
+        "device->host sync in an unannotated kernel/executor-layer "
+        "function — every sanctioned drain point declares a "
+        "`# contract:` budget; a bare sync is a hot-path regression"),
+    "dispatch-contract-syntax": (
+        "unparseable `# contract:` comment — a typo here silently "
+        "un-checks the budget"),
+}
+
+# the kernel/executor layer dispatch-sync polices (contract functions
+# are budget-checked instead; everything else in the repo is host code
+# where np.asarray is routine)
+HOT_PATH_FILES = (
+    "hstream_tpu/engine/lattice.py",
+    "hstream_tpu/engine/executor.py",
+    "hstream_tpu/engine/join.py",
+    "hstream_tpu/engine/pipeline.py",
+    "hstream_tpu/parallel/executor.py",
+    "hstream_tpu/parallel/lattice.py",
+)
+
+# factories whose RESULT is a compiled kernel callable
+KERNEL_FACTORIES = {
+    "jit", "pjit", "shard_map",
+    "join_probe_insert", "join_probe_only", "join_probe_insert_step",
+    "join_evict", "compiled_encoded_step",
+    "_count_close_kernel",
+}
+# factories returning a NAMESPACE of kernels (attributes are kernels)
+KERNEL_NAMESPACE_FACTORIES = {"compiled", "ShardedLattice",
+                              "ShardedJoinLattice"}
+
+# device-value lexicon: identifier stems that name device arrays in
+# this codebase (packed extract buffers, wire words, lattice state)
+_DEVICE_NAME_RE = re.compile(
+    r"(^|_)(packed|buf|bufs|words|state|dev|device|stacked)($|_|s$)")
+
+_CONTRACT_RE = re.compile(r"#\s*contract:\s*(.+)$")
+_BUDGET_RE = re.compile(r"^(dispatches|fetches)<=(\d+)$")
+
+# loop-iterable source text marking the sanctioned shape-group
+# stacking idiom (one fetch per compiled buffer shape)
+_SHAPE_GROUP_TOKENS = ("by_shape",)
+
+_FETCH_METHODS = {"block_until_ready", "item"}
+
+
+def _parse_contract(text: str) -> dict[str, int] | None:
+    """{'dispatches': N, 'fetches': M} (either optional) or None on a
+    syntax error."""
+    out: dict[str, int] = {}
+    for tok in text.split():
+        m = _BUDGET_RE.match(tok)
+        if not m:
+            return None
+        out[m.group(1)] = int(m.group(2))
+    return out or None
+
+
+def _contract_of(src, fn: ast.FunctionDef):
+    """(budgets, comment_line) for a contract bound to `fn`, or
+    (None, line) on a malformed comment, or (None, None)."""
+    # same-line comment on the def
+    def_line = src.lines[fn.lineno - 1] if fn.lineno <= len(src.lines) \
+        else ""
+    m = _CONTRACT_RE.search(def_line)
+    if m is not None:
+        return _parse_contract(m.group(1)), fn.lineno
+    # comment-only lines directly above the def / its decorators
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    i = first - 1  # 1-based line above
+    while i >= 1:
+        line = src.lines[i - 1].strip()
+        if not line.startswith("#"):
+            break
+        m = _CONTRACT_RE.search(line)
+        if m is not None:
+            return _parse_contract(m.group(1)), i
+        i -= 1
+    return None, None
+
+
+def _class_kernel_attrs(cls: ast.ClassDef) -> set[str]:
+    """self-attribute names assigned from kernel factories anywhere in
+    the class (e.g. `self._extract_touched = fns.extract_touched` where
+    `fns = lattice.compiled(...)`)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        ns_vars: set[str] = set()  # locals holding kernel namespaces
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign) or not stmt.targets:
+                continue
+            rhs = stmt.value
+            leaf = (call_name(rhs) or "").split(".")[-1] \
+                if isinstance(rhs, ast.Call) else None
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and \
+                        leaf in KERNEL_NAMESPACE_FACTORIES:
+                    ns_vars.add(t.id)
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if leaf in KERNEL_FACTORIES:
+                    out.add(t.attr)
+                elif leaf in KERNEL_NAMESPACE_FACTORIES:
+                    ns_vars.add(f"self.{t.attr}")
+                elif isinstance(rhs, ast.Attribute):
+                    base = dotted(rhs.value)
+                    if base in ns_vars:
+                        out.add(t.attr)
+        # second sweep: attributes of namespace vars found above
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            rhs = stmt.value
+            if not isinstance(rhs, ast.Attribute):
+                continue
+            base = dotted(rhs.value)
+            if base not in ns_vars:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+def _local_kernel_names(fn: ast.FunctionDef) -> set[str]:
+    """Local names bound from kernel factories inside `fn`
+    (`kern = lattice.join_probe_insert(...)`, `step = jax.jit(...)`)."""
+    out: set[str] = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call):
+            leaf = (call_name(stmt.value) or "").split(".")[-1]
+            if leaf in KERNEL_FACTORIES:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _device_locals(fn: ast.FunctionDef, kernels: set[str]) -> set[str]:
+    """Local names assigned (incl. tuple-unpacked) from jnp./jax. calls
+    or kernel-callable calls — device values by construction."""
+    out: set[str] = set()
+
+    def _is_device_call(v: ast.AST) -> bool:
+        if not isinstance(v, ast.Call):
+            return False
+        name = call_name(v) or ""
+        if name.startswith(("jnp.", "jax.")) and \
+                not name.startswith("jax.profiler"):
+            return True
+        leaf = name.split(".")[-1]
+        return leaf in kernels or name in kernels \
+            or (name.startswith("self.") and leaf in kernels)
+
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and _is_device_call(stmt.value):
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        # iterating a device container (state planes, staged buffers)
+        # makes the loop/comprehension targets device values too
+        elif isinstance(stmt, (ast.For, ast.comprehension)):
+            if _mentions_device(stmt.iter, out):
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+def _mentions_device(node: ast.AST, device_locals: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            if sub.id in device_locals or \
+                    _DEVICE_NAME_RE.search(sub.id):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if _DEVICE_NAME_RE.search(sub.attr):
+                return True
+    return False
+
+
+def _is_fetch(call: ast.Call, device_locals: set[str],
+              in_contract: bool) -> bool:
+    name = call_name(call) or ""
+    leaf = name.split(".")[-1]
+    if leaf in _FETCH_METHODS:
+        return True
+    if name in ("jax.device_get", "device_get"):
+        return True
+    if leaf == "asarray" and name.split(".")[0] in ("np", "numpy"):
+        if any(kw.arg == "dtype" for kw in call.keywords) \
+                or len(call.args) > 1:
+            return False  # host-typed conversion, the repo's idiom
+        if not call.args:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, (ast.List, ast.Tuple, ast.Constant)):
+            return False  # literal -> host construction
+        if in_contract:
+            return True  # contract paths are device paths
+        return _mentions_device(arg, device_locals)
+    return False
+
+
+def _is_dispatch(call: ast.Call, kernels: set[str],
+                 local_kernels: set[str]) -> bool:
+    name = call_name(call) or ""
+    if not name:
+        return False
+    leaf = name.split(".")[-1]
+    if isinstance(call.func, ast.Name):
+        return leaf in local_kernels
+    if name.startswith("self."):
+        return leaf in kernels
+    return False
+
+
+class _Budget:
+    """Branch-aware static (dispatches, fetches) counter that also
+    reports loop violations."""
+
+    def __init__(self, src, fn, kernels, local_kernels, device_locals,
+                 in_contract):
+        self.src = src
+        self.fn = fn
+        self.kernels = kernels
+        self.local_kernels = local_kernels
+        self.device_locals = device_locals
+        self.in_contract = in_contract
+        self.loop_findings: list[tuple[int, str, str]] = []
+
+    def _expr_sites(self, node: ast.AST | None) -> tuple[int, int]:
+        """(dispatches, fetches) in one expression subtree."""
+        if node is None:
+            return 0, 0
+        d = f = 0
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_dispatch(sub, self.kernels, self.local_kernels):
+                d += 1
+            elif _is_fetch(sub, self.device_locals, self.in_contract):
+                f += 1
+        return d, f
+
+    def _stmt_sites(self, stmt: ast.stmt) -> tuple[int, int]:
+        """(dispatches, fetches) in one statement's OWN expressions:
+        compound statements contribute only their header (test / iter /
+        with-items) — their bodies are counted recursively by count()."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            return self._expr_sites(stmt.test)
+        if isinstance(stmt, ast.For):
+            return self._expr_sites(stmt.iter)
+        if isinstance(stmt, ast.With):
+            d = f = 0
+            for item in stmt.items:
+                d2, f2 = self._expr_sites(item.context_expr)
+                d += d2
+                f += f2
+            return d, f
+        if isinstance(stmt, (ast.Try, ast.FunctionDef)):
+            return 0, 0
+        return self._expr_sites(stmt)
+
+    def _loop_sanctioned(self, loop) -> bool:
+        if not isinstance(loop, ast.For):
+            return False
+        try:
+            text = ast.unparse(loop.iter)
+        except Exception:  # noqa: BLE001 — unparse is best-effort
+            text = ""
+        return any(tok in text for tok in _SHAPE_GROUP_TOKENS)
+
+    def count(self, stmts: list[ast.stmt]) -> tuple[int, int]:
+        if not stmts:
+            return 0, 0
+        head, rest = stmts[0], stmts[1:]
+        hd, hf = self._stmt_sites(head)
+        if isinstance(head, ast.If):
+            bd, bf = self.count(head.body)
+            od, of_ = self.count(head.orelse)
+
+            def _terminates(body):
+                return bool(body) and isinstance(
+                    body[-1], (ast.Return, ast.Raise, ast.Continue,
+                               ast.Break))
+
+            rd, rf = self.count(rest)
+            if _terminates(head.body):
+                return (hd + max(bd, od + rd), hf + max(bf, of_ + rf))
+            if _terminates(head.orelse):
+                return (hd + max(od, bd + rd), hf + max(of_, bf + rf))
+            return (hd + max(bd, od) + rd, hf + max(bf, of_) + rf)
+        if isinstance(head, (ast.For, ast.While)):
+            bd, bf = self.count(head.body)
+            od, of_ = self.count(head.orelse)
+            if (bd or bf) and not self._loop_sanctioned(head):
+                kind = "dispatch" if bd else "fetch"
+                try:
+                    it = ast.unparse(head.iter) \
+                        if isinstance(head, ast.For) else "while"
+                except Exception:  # noqa: BLE001
+                    it = "loop"
+                self.loop_findings.append((head.lineno, kind, it))
+            rd, rf = self.count(rest)
+            return hd + bd + od + rd, hf + bf + of_ + rf
+        if isinstance(head, (ast.With, ast.Try)):
+            bodies = [head.body]
+            if isinstance(head, ast.Try):
+                bodies += [h.body for h in head.handlers]
+                bodies += [head.orelse, head.finalbody]
+            bd = bf = 0
+            for b in bodies:
+                d2, f2 = self.count(b)
+                bd += d2
+                bf += f2
+            rd, rf = self.count(rest)
+            return hd + bd + rd, hf + bf + rf
+        if isinstance(head, ast.FunctionDef):
+            rd, rf = self.count(rest)
+            return rd, rf  # nested def: counted when IT is annotated
+        rd, rf = self.count(rest)
+        return hd + rd, hf + rf
+
+
+def _jit_decorated(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(d) or ""
+        if name.split(".")[-1] in ("jit", "shard_map", "pjit"):
+            return True
+    return False
+
+
+def run(files, repo) -> list[Finding]:
+    out: list[Finding] = []
+    for src in files:
+        kernel_attrs: set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                kernel_attrs |= _class_kernel_attrs(node)
+        hot = src.rel in HOT_PATH_FILES
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            budgets, cline = _contract_of(src, fn)
+            if cline is not None and budgets is None:
+                out.append(Finding(
+                    "dispatch-contract-syntax", src.rel, cline,
+                    f"contract comment on {fn.name} does not parse — "
+                    f"expected `# contract: dispatches<=N fetches<=M`"))
+                continue
+            local_kernels = _local_kernel_names(fn)
+            device_locals = _device_locals(
+                fn, kernel_attrs | local_kernels)
+            if budgets is not None:
+                b = _Budget(src, fn, kernel_attrs, local_kernels,
+                            device_locals, in_contract=True)
+                d, f = b.count(fn.body)
+                for line, kind, it in b.loop_findings:
+                    out.append(Finding(
+                        "dispatch-budget", src.rel, line,
+                        f"{fn.name}: {kind} inside a loop over {it} — "
+                        f"the per-cycle budget cannot hold"))
+                nd = budgets.get("dispatches")
+                if nd is not None and d > nd:
+                    out.append(Finding(
+                        "dispatch-budget", src.rel, fn.lineno,
+                        f"{fn.name}: {d} static dispatch site(s) "
+                        f"exceed the declared dispatches<={nd}"))
+                nf = budgets.get("fetches")
+                if nf is not None and f > nf:
+                    out.append(Finding(
+                        "dispatch-budget", src.rel, fn.lineno,
+                        f"{fn.name}: {f} static fetch site(s) exceed "
+                        f"the declared fetches<={nf}"))
+            elif hot and not _jit_decorated(fn):
+                nested: set[int] = set()
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.FunctionDef) and sub is not fn:
+                        for inner in ast.walk(sub):
+                            nested.add(id(inner))
+                for sub in ast.walk(fn):
+                    if id(sub) in nested:
+                        continue  # nested defs are their own scope
+                    if isinstance(sub, ast.Call) and \
+                            _is_fetch(sub, device_locals, False):
+                        out.append(Finding(
+                            "dispatch-sync", src.rel, sub.lineno,
+                            f"{fn.name}: device sync "
+                            f"{call_name(sub) or '<call>'}() without a "
+                            f"`# contract:` budget — annotate the "
+                            f"drain or move the sync off the hot path"))
+    return out

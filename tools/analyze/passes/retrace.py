@@ -1,0 +1,298 @@
+"""Retrace discipline pass.
+
+An XLA executable is keyed by (function identity, argument shapes,
+static-arg values). The repo keeps steady-state recompiles at ZERO with
+two idioms: kernel factories are memoized (`functools.lru_cache` on
+`lattice.compiled` / `compiled_encoded_step` / the `join_*` factories,
+`build_*` constructors called only from them) and every shape-bearing
+argument is padded to a sticky power of two (`_stage_cap`, `_dev_bcap`,
+`_pad_slots`) so varying batch/cycle widths converge on a few compiled
+programs. This pass flags the ways that discipline silently breaks:
+
+  retrace-uncached-jit  a `jax.jit`/`shard_map` wrapper constructed
+                        inside a plain function (a per-call path): each
+                        call builds a FRESH wrapper whose cache is
+                        itself, so every invocation retraces. The
+                        sanctioned shapes are lru_cache-decorated
+                        factories, `build_*`/`mk_*`/`_build*`
+                        constructors, `_compile`, and `__init__`.
+  retrace-traced-branch a Python `if`/`while` on a traced argument
+                        inside a jitted function — either a TracerBool
+                        error or, with static args, a retrace per
+                        distinct value (`x is None` tests are exempt:
+                        None never traces).
+  retrace-static-arg    `static_argnums`/`static_argnames` naming a
+                        parameter whose default/annotation is a float,
+                        list, or dict — floats retrace per distinct
+                        value, unhashables TypeError at call time.
+  retrace-shape-key     a memoized kernel factory called with a raw
+                        `len(<batch-like>)` — unpadded shape keys
+                        compile one executable per distinct size;
+                        route through round_up_pow2 / the sticky-cap
+                        helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import Finding
+from tools.analyze.passes import call_name, dotted
+from tools.analyze.passes.purity import _jitted_functions
+
+NAME = "retrace"
+
+RULES = {
+    "retrace-uncached-jit": (
+        "jax.jit/shard_map wrapper constructed inside a per-call "
+        "function — each call builds a fresh wrapper and retraces; "
+        "memoize via an lru_cache factory (the build_* idiom)"),
+    "retrace-traced-branch": (
+        "Python if/while on a traced argument inside a jitted "
+        "function — TracerBool error or a retrace per value; use "
+        "jnp.where/lax.cond"),
+    "retrace-static-arg": (
+        "static_argnums/static_argnames targets a float/list/dict "
+        "parameter — float statics retrace per distinct value, "
+        "unhashables TypeError"),
+    "retrace-shape-key": (
+        "memoized kernel factory called with a raw len() of a batch "
+        "value — unpadded shape keys defeat the pow2-padding compile "
+        "cache"),
+}
+
+_SANCTIONED_PREFIXES = ("build_", "_build", "mk_")
+_SANCTIONED_NAMES = {"_compile", "__init__", "compiled"}
+
+# in-tree memoized kernel factories (by leaf name) whose arguments are
+# compile-cache keys; module-local lru_cache'd defs are added per file
+_KNOWN_FACTORIES = {
+    "join_probe_insert", "join_probe_only", "join_probe_insert_step",
+    "join_evict", "compiled_encoded_step", "compiled",
+}
+
+_BATCHISH = ("batch", "batches", "rows", "codes", "kids", "matches",
+             "keys", "vals", "records", "ts")
+
+
+def _is_jit_name(name: str | None) -> bool:
+    return bool(name) and name.split(".")[-1] in ("jit", "shard_map",
+                                                  "pjit")
+
+
+def _is_cached_factory_def(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = (dotted(d) or "").split(".")[-1]
+        if name in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def _sanctioned(fn: ast.FunctionDef) -> bool:
+    if _is_cached_factory_def(fn):
+        return True
+    if fn.name in _SANCTIONED_NAMES:
+        return True
+    return fn.name.startswith(_SANCTIONED_PREFIXES)
+
+
+def _enclosers(tree: ast.Module) -> dict[int, list[ast.FunctionDef]]:
+    """node id -> chain of enclosing FunctionDefs (outermost first)."""
+    out: dict[int, list[ast.FunctionDef]] = {}
+
+    def visit(node: ast.AST, chain: list[ast.FunctionDef]) -> None:
+        for child in ast.iter_child_nodes(node):
+            nxt = chain
+            if isinstance(child, ast.FunctionDef):
+                out[id(child)] = chain
+                nxt = chain + [child]
+            else:
+                out[id(child)] = chain
+            visit(child, nxt)
+
+    visit(tree, [])
+    return out
+
+
+def _uncached_jit(src) -> list[Finding]:
+    out: list[Finding] = []
+    chains = _enclosers(src.tree)
+    for node in ast.walk(src.tree):
+        site = None
+        what = None
+        chain = None
+        if isinstance(node, ast.Call) and _is_jit_name(call_name(node)):
+            site, what = node, call_name(node)
+            chain = chains.get(id(node), [])
+        elif isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_jit_name(dotted(d)):
+                    site, what = dec, f"@{dotted(d)} {node.name}"
+                    # the decorated def's own chain: its ENCLOSERS,
+                    # not itself
+                    chain = chains.get(id(node), [])
+        if site is None:
+            continue
+        if not chain:
+            continue  # module level: compiled once per import
+        if any(_sanctioned(fn) for fn in chain):
+            continue
+        out.append(Finding(
+            "retrace-uncached-jit", src.rel, site.lineno,
+            f"{what} constructed inside {chain[-1].name}() — a "
+            f"per-call wrapper retraces every invocation; memoize "
+            f"via an lru_cache factory"))
+    return out
+
+
+def _traced_branches(src) -> list[Finding]:
+    out: list[Finding] = []
+    for fn, _how in _jitted_functions(src.tree):
+        params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                                  + fn.args.kwonlyargs)}
+        params.discard("self")
+        nested: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                for inner in ast.walk(node):
+                    nested.add(id(inner))
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue  # nested defs: separate trace scopes
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            # `x is (not) None` never traces (None is a static default)
+            if isinstance(test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+                continue
+            hit = None
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Call):
+                    leaf = (call_name(sub) or "").split(".")[-1]
+                    if leaf == "isinstance":
+                        hit = None
+                        break
+                if isinstance(sub, ast.Name) and sub.id in params:
+                    hit = sub.id
+            if hit:
+                out.append(Finding(
+                    "retrace-traced-branch", src.rel, node.lineno,
+                    f"jitted fn {fn.name} branches on traced argument "
+                    f"'{hit}' with Python "
+                    f"{'if' if isinstance(node, ast.If) else 'while'}"))
+    return out
+
+
+def _static_args(src) -> list[Finding]:
+    """jit(f, static_argnums/names=...) where the named param of `f`
+    (resolved by name in the same module) defaults to / is annotated as
+    float/list/dict."""
+    out: list[Finding] = []
+    defs = {n.name: n for n in ast.walk(src.tree)
+            if isinstance(n, ast.FunctionDef)}
+
+    def _bad_param(fn: ast.FunctionDef, idx: int | None,
+                   pname: str | None):
+        args = fn.args.posonlyargs + fn.args.args
+        a = None
+        if pname is not None:
+            a = next((x for x in args if x.arg == pname), None)
+        elif idx is not None and idx < len(args):
+            a = args[idx]
+        if a is None:
+            return None
+        ann = getattr(a, "annotation", None)
+        if ann is not None:
+            t = (dotted(ann) or "").split(".")[-1]
+            if t in ("float", "list", "dict", "set"):
+                return a.arg, t
+        defaults = fn.args.defaults
+        pos = args.index(a) - (len(args) - len(defaults))
+        if 0 <= pos < len(defaults):
+            d = defaults[pos]
+            if isinstance(d, ast.Constant) and isinstance(d.value, float):
+                return a.arg, "float"
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                return a.arg, type(d).__name__.lower()
+        return None
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call) or \
+                not _is_jit_name(call_name(node)):
+            continue
+        target = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            target = defs.get(node.args[0].id)
+        if target is None:
+            continue
+        for kw in node.keywords:
+            hits = []
+            if kw.arg == "static_argnums":
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value])
+                for v in vals:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, int):
+                        hits.append(_bad_param(target, v.value, None))
+            elif kw.arg == "static_argnames":
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value])
+                for v in vals:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        hits.append(_bad_param(target, None, v.value))
+            for hit in hits:
+                if hit:
+                    pname, t = hit
+                    out.append(Finding(
+                        "retrace-static-arg", src.rel, node.lineno,
+                        f"static arg '{pname}' of {target.name} is "
+                        f"{t}-typed — retraces per value / "
+                        f"unhashable"))
+    return out
+
+
+def _shape_keys(src) -> list[Finding]:
+    factories = set(_KNOWN_FACTORIES)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and \
+                _is_cached_factory_def(node):
+            factories.add(node.name)
+    out: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = (call_name(node) or "").split(".")[-1]
+        if leaf not in factories:
+            continue
+        for arg in node.args:
+            if not (isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "len" and arg.args):
+                continue
+            inner = arg.args[0]
+            name = (dotted(inner) or "").split(".")[-1].lower()
+            if any(tok == name or name.endswith("_" + tok)
+                   for tok in _BATCHISH):
+                out.append(Finding(
+                    "retrace-shape-key", src.rel, arg.lineno,
+                    f"{leaf}(... len({dotted(inner)}) ...) keys the "
+                    f"compile cache on a raw size — pad via "
+                    f"round_up_pow2 / a sticky cap"))
+    return out
+
+
+def run(files, repo) -> list[Finding]:
+    out: list[Finding] = []
+    for src in files:
+        out.extend(_uncached_jit(src))
+        out.extend(_traced_branches(src))
+        out.extend(_static_args(src))
+        out.extend(_shape_keys(src))
+    return out

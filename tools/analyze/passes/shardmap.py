@@ -1,0 +1,205 @@
+"""shard_map hygiene pass.
+
+The sharded lattice runs its hot step under `jax.shard_map` with zero
+collectives; merges (psum/pmin/pmax over the data axis) ride ICI only
+at drain points. Three ways that discipline breaks, each invisible to
+single-device tests (the CI jax build lacks shard_map entirely):
+
+  shardmap-collective  a `jax.lax.p*` collective in a function that is
+                       never wrapped by shard_map (directly, or called
+                       from a shard_map body in the same module) — an
+                       unbound axis name raises at trace time on the
+                       first REAL mesh run.
+  shardmap-callback    a host callback / fetch (`jax.debug.*`,
+                       `io_callback`/`pure_callback`/`host_callback`,
+                       `np.asarray`, `.item()`, `device_get`, `print`)
+                       inside a shard_map body: per-shard host syncs
+                       serialize the mesh and deadlock multi-host
+                       meshes.
+  shardmap-axis        a collective naming a LITERAL axis that no
+                       Mesh(...)/axis declaration in the module spells
+                       — a typo that trips only on mesh hardware.
+
+Body discovery mirrors the purity pass (functions passed to shard_map
+by name, nested construction, decorator form) and then closes over
+same-module helpers called BY those bodies (`merged_col` called from
+`extract_local` is mesh code too).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import Finding
+from tools.analyze.passes import call_name, dotted
+
+NAME = "shardmap"
+
+RULES = {
+    "shardmap-collective": (
+        "jax.lax collective outside any shard_map body — the axis "
+        "name is unbound; raises at trace time on a real mesh"),
+    "shardmap-callback": (
+        "host callback/fetch inside a shard_map body — per-shard "
+        "host syncs serialize the mesh and deadlock multi-host runs"),
+    "shardmap-axis": (
+        "collective names an axis literal no Mesh/axis declaration in "
+        "the module spells — a typo that only trips on mesh hardware"),
+}
+
+_COLLECTIVES = {"psum", "pmin", "pmax", "pmean", "all_gather",
+                "ppermute", "all_to_all", "axis_index", "pshuffle",
+                "psum_scatter"}
+_CALLBACKS = {"io_callback", "pure_callback", "host_callback",
+              "callback", "print", "breakpoint"}
+_FETCHES = {"asarray", "item", "device_get", "block_until_ready"}
+
+
+def _shard_map_bodies(tree: ast.Module) -> set[int]:
+    """ids of FunctionDefs that execute inside shard_map, closed over
+    same-module callees."""
+    defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def is_sm(name: str | None) -> bool:
+        return bool(name) and name.split(".")[-1] == "shard_map"
+
+    body_ids: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(d)
+                inner = None
+                if isinstance(dec, ast.Call) and name and \
+                        name.split(".")[-1] == "partial" and dec.args:
+                    inner = dotted(dec.args[0])
+                if is_sm(name) or is_sm(inner):
+                    body_ids.add(id(node))
+        elif isinstance(node, ast.Call) and is_sm(call_name(node)):
+            args = list(node.args)
+            if args and isinstance(args[0], ast.Name):
+                for fn in defs_by_name.get(args[0].id, ()):
+                    body_ids.add(id(fn))
+
+    # transitive closure: helpers called from shard_map bodies by bare
+    # name are mesh code too
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef) or \
+                    id(node) not in body_ids:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    for fn in defs_by_name.get(sub.func.id, ()):
+                        if id(fn) not in body_ids:
+                            body_ids.add(id(fn))
+                            changed = True
+    return body_ids
+
+
+def _declared_axes(tree: ast.Module) -> set[str]:
+    """Axis-name string literals declared in the module: Mesh(...)
+    arguments, `axis_names=`/`*_axis=` keywords, and `*_axis`
+    parameter defaults."""
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            leaf = (call_name(node) or "").split(".")[-1]
+            if leaf == "Mesh":
+                for arg in node.args[1:]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            axes.add(sub.value)
+            for kw in node.keywords:
+                if kw.arg and (kw.arg == "axis_names"
+                               or kw.arg.endswith("_axis")):
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            axes.add(sub.value)
+        elif isinstance(node, ast.FunctionDef):
+            args = node.args.posonlyargs + node.args.args
+            defaults = node.args.defaults
+            for a, d in zip(args[len(args) - len(defaults):], defaults):
+                if a.arg.endswith("_axis") and \
+                        isinstance(d, ast.Constant) and \
+                        isinstance(d.value, str):
+                    axes.add(d.value)
+    return axes
+
+
+def _axis_literal(call: ast.Call) -> str | None:
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis_index_groups") and \
+                isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value  # axis_index("data")
+    return None
+
+
+def run(files, repo) -> list[Finding]:
+    out: list[Finding] = []
+    for src in files:
+        bodies = _shard_map_bodies(src.tree)
+        if not bodies and not any(
+                isinstance(n, ast.Call)
+                and (call_name(n) or "").split(".")[-1] in _COLLECTIVES
+                and (call_name(n) or "").startswith(("jax.lax.", "lax."))
+                for n in ast.walk(src.tree)):
+            continue
+        axes = _declared_axes(src.tree)
+        body_nodes: dict[int, str] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef) and id(node) in bodies:
+                for sub in ast.walk(node):
+                    body_nodes.setdefault(id(sub), node.name)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            leaf = name.split(".")[-1]
+            if leaf in _COLLECTIVES and \
+                    name.startswith(("jax.lax.", "lax.")):
+                if id(node) not in body_nodes:
+                    out.append(Finding(
+                        "shardmap-collective", src.rel, node.lineno,
+                        f"{name}() outside any shard_map body — its "
+                        f"axis name is unbound on a real mesh"))
+                lit = _axis_literal(node)
+                if lit is not None and axes and lit not in axes:
+                    out.append(Finding(
+                        "shardmap-axis", src.rel, node.lineno,
+                        f"{name}() names axis {lit!r}; the module "
+                        f"declares {sorted(axes)}"))
+            elif id(node) in body_nodes:
+                where = body_nodes[id(node)]
+                if leaf in _CALLBACKS and (
+                        name.startswith(("jax.debug.", "debug."))
+                        or leaf in ("io_callback", "pure_callback",
+                                    "host_callback", "print",
+                                    "breakpoint")):
+                    out.append(Finding(
+                        "shardmap-callback", src.rel, node.lineno,
+                        f"shard_map body {where} invokes host "
+                        f"callback {name}()"))
+                elif leaf in _FETCHES and (
+                        name.split(".")[0] in ("np", "numpy", "jax")
+                        or leaf in ("item", "block_until_ready")):
+                    out.append(Finding(
+                        "shardmap-callback", src.rel, node.lineno,
+                        f"shard_map body {where} fetches to host via "
+                        f"{name}()"))
+    return out

@@ -12,6 +12,7 @@ import numpy as np
 
 def main():
     # force sync mode
+    # analyze: ok retrace-uncached-jit — one-shot profiling CLI
     np.asarray(jax.jit(lambda: jnp.zeros(1))())
 
     MB = 1024 * 1024
@@ -49,6 +50,7 @@ def main():
 
     # dispatch-only cost on resident data in sync mode
     st = jax.device_put(np.zeros((1024, 1024), np.float32))
+    # analyze: ok retrace-uncached-jit — one-shot profiling CLI
     f = jax.jit(lambda s, x: s + jnp.sum(x.astype(jnp.float32)))
     float(np.asarray(jnp.sum(f(st, d))))  # compile
     t0 = time.perf_counter()

@@ -27,6 +27,7 @@ def main():
         print(f"put {sh!s:>18}: {d*1e3:7.1f} ms -> {buf.nbytes/d/1e6:8.1f} MB/s")
 
     # does block_until_ready force execution? compare with explicit fetch
+    # analyze: ok retrace-uncached-jit — one-shot profiling CLI
     @jax.jit
     def burn(x):
         def body(i, acc):
