@@ -200,6 +200,41 @@ def cmd_metrics(stub, args) -> list[dict]:
     return []
 
 
+def cmd_fault(stub, args) -> list[dict]:
+    """Chaos fault sites: arm/clear/list deterministic fault schedules
+    (fail:N / prob:P:SEED / delay:MS / torn:N:SEED) on named sites."""
+    if args.action == "list":
+        out = _admin(stub, "fault-list")[0]
+        sites = out.get("sites", {})
+        return ([{"site": s, **v} for s, v in sorted(sites.items())]
+                or [{"active": out.get("active", False)}])
+    if args.site is None:
+        if args.action == "clear":
+            return _admin(stub, "fault-clear")  # no site: clear ALL
+        raise SystemExit(f"fault {args.action} needs a site")
+    if args.action == "set":
+        if args.spec is None:
+            raise SystemExit("fault set needs a spec (e.g. fail:3)")
+        return _admin(stub, "fault-set", site=args.site, spec=args.spec)
+    return _admin(stub, "fault-clear", site=args.site)
+
+
+def cmd_supervisor(stub, args) -> list[dict]:
+    """Query-supervision status: pending restarts + open breakers."""
+    resp = _admin(stub, "supervisor")
+    out = resp[0] if resp else {}
+    rows = [{"": "restarts", "value": out.get("restarts", 0),
+             "detail": ""}]
+    for qid, p in sorted(out.get("pending", {}).items()):
+        rows.append({"": f"pending {qid}",
+                     "value": f"attempt {p.get('attempt')}",
+                     "detail": f"due in {p.get('due_in_s')}s"})
+    for qid in out.get("breaker_open", []):
+        rows.append({"": f"breaker {qid}", "value": "OPEN",
+                     "detail": "RestartQuery to reset"})
+    return rows
+
+
 def cmd_flow(stub, args) -> list[dict]:
     """Live flow-control status: shed level, overload signals, active
     quotas, per-class shed counters."""
@@ -281,6 +316,19 @@ def main(argv=None) -> int:
     sub.add_parser("metrics",
                    help="raw Prometheus text exposition "
                         "(same as gateway GET /metrics)")
+    p = sub.add_parser("fault",
+                       help="chaos fault sites: set/clear/list "
+                            "deterministic fault schedules")
+    p.add_argument("action", choices=["set", "clear", "list"])
+    p.add_argument("site", nargs="?", default=None,
+                   help="fault site name (e.g. store.append); "
+                        "clear with no site disarms every site")
+    p.add_argument("spec", nargs="?", default=None,
+                   help="schedule: fail:N | prob:P[:SEED] | "
+                        "delay:MS | torn:N[:SEED]")
+    sub.add_parser("supervisor",
+                   help="query supervision: pending restarts and "
+                        "crash-loop breakers")
     args = ap.parse_args(argv)
 
     fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]

@@ -38,6 +38,8 @@ import numpy as np
 
 from hstream_tpu.common.columnar import ColumnarEmit, extend_rows
 from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.common.faultinject import FAULTS
+from hstream_tpu.common.logger import get_logger
 from hstream_tpu.engine import lattice, transport
 from hstream_tpu.engine.expr import (
     BinOp,
@@ -58,6 +60,8 @@ from hstream_tpu.engine.types import (
     round_up_pow2,
 )
 from hstream_tpu.engine.window import FixedWindow, SessionWindow
+
+log = get_logger("executor")
 
 REBASE_THRESHOLD = 1 << 30  # re-anchor epoch when relative time passes this
 
@@ -212,6 +216,12 @@ class QueryExecutor:
         # windows are due — tests and bench assert on these
         self.close_stats = {"close_cycles": 0, "close_dispatches": 0,
                             "close_fetches": 0}
+        # fused-close health: a fused kernel failure (activation /
+        # compile / injected fault) permanently degrades THIS executor
+        # to the retained per-slot reference close; the query task
+        # mirrors device_fallbacks into device_path_fallbacks
+        self._fused_close_ok = True
+        self.device_fallbacks = 0
         # cached reverse key-index columns for vectorized key decode:
         # (version = len(_key_rev) when built, [object array per group
         # column]); _key_rev is append-only so a stale cache is only
@@ -315,6 +325,8 @@ class QueryExecutor:
         jitted (decode+scatter) step. Null streams, once seen, stay on the
         wire (sticky) so the encoding combo — and the compiled executable
         — is stable batch-to-batch."""
+        if FAULTS.active:  # chaos: fail/delay a staged step dispatch
+            FAULTS.point("device.dispatch")
         combo, bases, words = self._encode_locked(
             cap, n, key_ids, ts_rel, cols, valid, null_streams)
         step = lattice.compiled_encoded_step(
@@ -1055,28 +1067,88 @@ class QueryExecutor:
         """Pop + close every window in `starts` with ONE fused
         extract+reset dispatch (the close-cycle contract: one lattice
         kernel and one device->host fetch regardless of how many
-        windows are due)."""
+        windows are due). A fused-kernel failure (activation/compile,
+        device loss, or an injected ``device.activate`` fault) degrades
+        this executor PERMANENTLY to the retained per-slot reference
+        close — identical results, counted in device_fallbacks —
+        instead of killing the query (ISSUE 8)."""
         if not starts:
             return []
-        slots = self._pad_slots([self._open.pop(s).slot for s in starts])
+        ows = [(s, self._open.pop(s).slot) for s in starts]
         self.close_stats["close_cycles"] += 1
+        if not self._fused_close_ok:
+            return self._close_windows_ref(ows)
+        slots = self._pad_slots([slot for _s, slot in ows])
+        packed = None
+        prev_state = self.state  # no donation: stays valid for restore
+        try:
+            if FAULTS.active:  # chaos: provoke a fused-close failure
+                FAULTS.point("device.activate")
+            if self.emit_changes:
+                # the changelog already carried final values: batched
+                # reset only, no extract and no fetch
+                self.state = self._reset_slots(self.state, slots)
+            else:
+                self.state, packed = self._extract_reset_slots(
+                    self.state, slots)
+        except Exception as e:  # noqa: BLE001 — dispatch failed before
+            # any state mutation (functional update): the reference
+            # path closes the same windows from unchanged state
+            log.warning(
+                "fused close failed (%s: %s); degrading to the "
+                "per-slot reference close", type(e).__name__, e)
+            self._fused_close_ok = False
+            self.device_fallbacks += 1
+            return self._close_windows_ref(ows)
         if self.emit_changes:
-            # the changelog already carried final values: batched reset
-            # only, no extract and no fetch
-            self.state = self._reset_slots(self.state, slots)
+            rows = []
+        elif self.defer_close_decode:
+            # keep the packed batch as a device value; no host sync
+            self._pending_closes.append((list(starts), packed))
             rows = []
         else:
-            self.state, packed = self._extract_reset_slots(self.state,
-                                                           slots)
-            if self.defer_close_decode:
-                # keep the packed batch as a device value; no host sync
-                self._pending_closes.append((list(starts), packed))
-                rows = []
-            else:
-                self.close_stats["close_fetches"] += 1
-                rows = self._decode_extract_batch(np.asarray(packed),
-                                                  starts)
+            self.close_stats["close_fetches"] += 1
+            try:
+                packed_host = np.asarray(packed)
+            except Exception as e:  # noqa: BLE001 — the dispatch is
+                # async: a device-side execution failure surfaces at
+                # this D2H sync, AFTER self.state was reassigned to the
+                # reset result. Restore the pre-close state (functional
+                # update, still valid) and close the same windows on
+                # the reference path instead of killing the query.
+                log.warning(
+                    "fused close fetch failed (%s: %s); degrading to "
+                    "the per-slot reference close", type(e).__name__, e)
+                self._fused_close_ok = False
+                self.device_fallbacks += 1
+                self.state = prev_state
+                return self._close_windows_ref(ows)
+            rows = self._decode_extract_batch(packed_host, starts)
         for s in starts:
+            self._no_close.discard(s)
+        return rows
+
+    def _close_windows_ref(self, ows: list) -> list[dict[str, Any]]:
+        """The retained per-slot reference close (the equivalence path
+        tests patch in): one extract + one reset dispatch per window,
+        per-kid row decode. Only reached after a fused-close failure —
+        correctness over dispatch count on a degraded executor."""
+        rows: list[dict[str, Any]] = []
+        for s, slot in ows:
+            if not self.emit_changes:
+                # degraded per-slot fallback after a fused-close
+                # failure; one fetch per window is the price of
+                # staying alive
+                # analyze: ok dispatch-sync — reference close fallback
+                packed = np.asarray(self._extract_slot(
+                    self.state, np.int32(slot)))
+                count, _sr, outs = lattice.unpack_extract_rows(
+                    self.spec, packed)
+                for kid in np.nonzero(count > 0)[0]:
+                    row = self._agg_row(int(kid), outs, int(kid), s)
+                    if row is not None:
+                        rows.append(row)
+            self.state = self._reset_slot(self.state, np.int32(slot))
             self._no_close.discard(s)
         return rows
 
@@ -1088,6 +1160,14 @@ class QueryExecutor:
         on real links."""
         if not self._pending_closes:
             return []
+        # A fetch failure here deliberately propagates: the deferred
+        # packed batches' source windows were reset when the close was
+        # deferred, so there is no pre-close state to fall back to —
+        # task death + supervised restart from snapshot (at-least-once
+        # replay) is the correct recovery, unlike the in-place degrade
+        # _close_windows can do at its own sync point.
+        if FAULTS.active:  # chaos: fail/delay the deferred-close drain
+            FAULTS.point("device.fetch")
         out = None
         if len(self._pending_closes) == 1:
             starts, packed_dev = self._pending_closes[0]

@@ -39,11 +39,14 @@ from typing import Any, Mapping, Sequence
 import numpy as np
 
 from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.common.logger import get_logger
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
 from hstream_tpu.engine.statestore import LastValueStore
 from hstream_tpu.engine.types import canon_key, round_up_pow2
 from hstream_tpu.engine.window import DEFAULT_GRACE_MS
+
+log = get_logger("join")
 
 _MISS = object()  # row.get sentinel: "field absent", distinct from None
 
@@ -511,6 +514,10 @@ class JoinExecutor(_JoinBase):
             "evict_dispatches": 0, "rebase_dispatches": 0,
             "store_grows": 0, "fused_batches": 0,
         }
+        # device activations that failed and degraded (permanently, for
+        # this executor) to the retained host reference path; the query
+        # task mirrors deltas into the device_path_fallbacks counter
+        self.device_fallbacks = 0
 
     # ---- ingest ------------------------------------------------------------
     #
@@ -993,7 +1000,23 @@ class JoinExecutor(_JoinBase):
         fast = self._fast_info()
         if fast is None:
             return False
-        return self._activate_device(fast)
+        try:
+            from hstream_tpu.common.faultinject import FAULTS
+
+            if FAULTS.active:  # chaos: provoke an activation failure
+                FAULTS.point("device.activate")
+            return self._activate_device(fast)
+        except Exception as e:  # noqa: BLE001 — an activation failure
+            # (kernel build, migration, device OOM, injected fault)
+            # degrades to the retained host reference path instead of
+            # killing the query; results are identical, only slower
+            log.warning(
+                "device join activation failed (%s: %s); staying on "
+                "the host reference path", type(e).__name__, e)
+            self._dev = None
+            self.use_device_join = False
+            self.device_fallbacks += 1
+            return False
 
     def _activate_device(self, fast: dict) -> bool:
         """Plan per-side column layouts from the fast-path need map and
@@ -1045,8 +1068,12 @@ class JoinExecutor(_JoinBase):
                        "r": _FlatIntervalStore(self._jcode_rev)},
         }
         self._dev["feed"] = self._build_feed_plans()
+        # migrate BOTH sides before clearing either host store: a
+        # failure partway (caught in _device_ready) must leave the host
+        # reference path intact to fall back on
         for s in ("l", "r"):
             self._migrate_store(s)
+        for s in ("l", "r"):
             self._stores[s] = _FlatIntervalStore(self._jcode_rev)
         return True
 

@@ -23,15 +23,64 @@ from __future__ import annotations
 import io
 import json
 import math
+import struct
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
-from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.common.errors import SQLCodegenError, StoreError
 from hstream_tpu.engine.types import ColumnType, Schema, StringDictionary
 
 SNAPSHOT_VERSION = 1
+
+# ---- CRC-sealed blob framing ------------------------------------------------
+#
+# A snapshot blob written to the meta KV is sealed with a magic + crc32
+# + length header so a torn or bit-rotted write is DETECTED at restore
+# instead of surfacing as a numpy/JSON parse error (or worse, parsing
+# into wrong state). The two-slot last-good rotation in
+# server.tasks relies on this: a corrupt newest slot falls back to the
+# previous sealed slot and replays the gap.
+
+SEAL_MAGIC = b"HSNP1\x00"
+_SEAL_HEADER = len(SEAL_MAGIC) + 8  # + u32 crc + u32 length
+
+
+class SnapshotCorrupt(StoreError):
+    """A sealed snapshot blob failed its integrity check."""
+
+
+def seal_blob(blob: bytes) -> bytes:
+    """Frame a snapshot blob with magic + crc32 + length."""
+    return (SEAL_MAGIC
+            + struct.pack("<II", zlib.crc32(blob) & 0xFFFFFFFF,
+                          len(blob))
+            + blob)
+
+
+def open_blob(data: bytes) -> bytes:
+    """Verify and unwrap a sealed blob. Legacy blobs (pre-seal raw npz,
+    which always starts with the zip magic ``PK``) pass through
+    unverified so snapshots written by older servers still restore.
+    Raises SnapshotCorrupt on truncation or checksum mismatch."""
+    if data.startswith(b"PK"):
+        return data  # legacy unsealed npz
+    if not data.startswith(SEAL_MAGIC):
+        raise SnapshotCorrupt(
+            f"snapshot blob has neither seal nor npz magic "
+            f"({data[:6]!r})")
+    if len(data) < _SEAL_HEADER:
+        raise SnapshotCorrupt("snapshot blob truncated inside header")
+    crc, length = struct.unpack_from("<II", data, len(SEAL_MAGIC))
+    blob = data[_SEAL_HEADER:]
+    if len(blob) != length:
+        raise SnapshotCorrupt(
+            f"snapshot blob truncated: {len(blob)} of {length} bytes")
+    if (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+        raise SnapshotCorrupt("snapshot blob checksum mismatch")
+    return blob
 
 
 # ---- tagged JSON for scalars JSON cannot carry ------------------------------

@@ -109,6 +109,29 @@ class ServerContext:
             credit_window=(DEFAULT_CREDIT_WINDOW if credit_window is None
                            else credit_window))
         self.flow.load()
+        # chaos harness: the process-wide fault registry journals every
+        # injection here; HSTREAM_FAULTS in the environment arms sites
+        # for the whole server (admin fault-set does it at runtime)
+        from hstream_tpu.common.faultinject import FAULTS
+
+        self.faults = FAULTS
+        FAULTS.bind_events(self.events)
+        FAULTS.load_env()
+        # self-healing supervision: tasks report unexpected deaths here;
+        # the servicer binds resume_fn once handlers exist
+        from hstream_tpu.server.scheduler import QuerySupervisor
+
+        self.supervisor = QuerySupervisor(self)
+        # the checkpoint-log replay above (LogCheckpointStore) happened
+        # before the journal existed: surface any corrupt entries it
+        # had to skip as a queryable event now
+        skipped = getattr(self.ckp_store, "replay_skipped", 0)
+        if skipped:
+            self.events.append(
+                "checkpoint_corrupt",
+                f"checkpoint-log replay skipped {skipped} corrupt "
+                f"entries; affected readers rewind and replay",
+                skipped=skipped)
 
     def _bump_boot_epoch(self) -> int:
         from hstream_tpu.store.versioned import VersionMismatch
@@ -131,6 +154,14 @@ class ServerContext:
                            "is racing this store")
 
     def shutdown(self) -> None:
+        # stop the supervisor FIRST: a restart racing shutdown would
+        # relaunch a task the loop below just stopped
+        sup = getattr(self, "supervisor", None)
+        if sup is not None:
+            try:
+                sup.shutdown()
+            except Exception:
+                pass
         httpd = getattr(self, "metrics_httpd", None)
         if httpd is not None:
             try:

@@ -47,7 +47,14 @@ from hstream_tpu.server.persistence import (
     now_ms,
 )
 from hstream_tpu.server.subscriptions import RecId
-from hstream_tpu.server.tasks import QueryTask, snapshot_key, stream_sink
+from hstream_tpu.common.faultinject import FAULTS
+from hstream_tpu.server.tasks import (
+    QueryTask,
+    parse_snapshot_pointer,
+    snapshot_key,
+    snapshot_slot_key,
+    stream_sink,
+)
 from hstream_tpu.server.views import Materialization, serve_select_view
 from hstream_tpu.sql import plans
 from hstream_tpu.sql.codegen import explain_text, stream_codegen
@@ -130,6 +137,8 @@ def unary(fn):
         t0 = time.perf_counter()
         with request_context(rid):
             try:
+                if FAULTS.active:  # chaos: fail/delay at handler entry
+                    FAULTS.point("rpc.handler")
                 return fn(self, request, context)
             except HStreamError as e:
                 _abort_hstream(context, e)
@@ -181,6 +190,11 @@ def _reject_virtual_name(kind: str, name: str) -> None:
 class HStreamApiServicer:
     def __init__(self, ctx: ServerContext):
         self.ctx = ctx
+        # self-healing: the supervisor restarts dead tasks through the
+        # same snapshot-resume path RestartQuery uses
+        sup = getattr(ctx, "supervisor", None)
+        if sup is not None:
+            sup.resume_fn = self._resume_query
 
     # ---- misc ---------------------------------------------------------------
 
@@ -407,6 +421,14 @@ class HStreamApiServicer:
         its snapshotted operator state + paired read checkpoints."""
         ctx = self.ctx
         info = ctx.persistence.get_query(request.id)
+        sup = getattr(ctx, "supervisor", None)
+        if sup is not None:
+            # operator intent overrides the crash-loop verdict: close
+            # the breaker and forget the death history. cancel (not
+            # reset) so an executing supervised restart is waited out
+            # first — otherwise both could pass the running check and
+            # double-start the query
+            sup.cancel(request.id)
         if request.id in ctx.running_queries:
             raise ServerError(f"query {request.id} is already running")
         self._resume_query(info)
@@ -735,9 +757,21 @@ class HStreamApiServicer:
         elif cmd == "snapshots":
             out = {}
             for key in ctx.store.meta_list("qsnap/"):
+                name = key[len("qsnap/"):]
+                if "@" in name:
+                    continue  # rotation slots surface via their pointer
                 blob = ctx.store.meta_get(key)
-                out[key[len("qsnap/"):]] = {
-                    "bytes": 0 if blob is None else len(blob)}
+                entry = {"bytes": 0 if blob is None else len(blob)}
+                slot = (None if blob is None
+                        else parse_snapshot_pointer(blob))
+                if slot is not None:
+                    # two-slot rotation: report the pointed-at blob,
+                    # not the ~20-byte pointer an operator would
+                    # mistake for the state size
+                    sb = ctx.store.meta_get(snapshot_slot_key(name, slot))
+                    entry = {"bytes": 0 if sb is None else len(sb),
+                             "slot": slot}
+                out[name] = entry
         elif cmd == "replicas":
             status = getattr(ctx.store, "follower_status", None)
             out = {"role": "leader" if status else "single",
@@ -768,6 +802,23 @@ class HStreamApiServicer:
                    for scope, q in ctx.flow.list_quotas().items()}
         elif cmd == "flow-status":
             out = ctx.flow.status()
+        elif cmd == "fault-set":
+            try:
+                ctx.faults.arm(str(args["site"]), str(args["spec"]))
+            except (KeyError, ValueError) as e:
+                raise ServerError(f"bad fault spec: {e}") from e
+            out = {"site": args["site"], "spec": args["spec"],
+                   "armed": True}
+        elif cmd == "fault-clear":
+            site = args.get("site") or None
+            ctx.faults.disarm(site)
+            out = {"cleared": site or "all"}
+        elif cmd == "fault-list":
+            out = {"active": ctx.faults.active,
+                   "sites": ctx.faults.status()}
+        elif cmd == "supervisor":
+            sup = getattr(ctx, "supervisor", None)
+            out = sup.status() if sup is not None else {}
         elif cmd == "events":
             out = {"events": ctx.events.query(
                 kind=args.get("kind") or None,
@@ -1094,14 +1145,22 @@ class HStreamApiServicer:
         return info
 
     def _remove_query_state(self, query_id: str) -> None:
-        """Durable per-query state cleanup: operator-state snapshot +
-        read checkpoints."""
+        """Durable per-query state cleanup: operator-state snapshot
+        (pointer + both rotation slots) + read checkpoints."""
         self.ctx.store.meta_delete(snapshot_key(query_id))
+        for slot in (0, 1):
+            self.ctx.store.meta_delete(
+                snapshot_slot_key(query_id, slot))
         self.ctx.ckp_store.remove(f"query-{query_id}")
 
     def _terminate_query(self, query_id: str) -> None:
         ctx = self.ctx
         ctx.persistence.get_query(query_id)  # raises if unknown
+        sup = getattr(ctx, "supervisor", None)
+        if sup is not None:
+            # an in-flight supervised restart must not resurrect a
+            # query the operator is terminating
+            sup.cancel(query_id)
         task = ctx.running_queries.pop(query_id, None)
         if task is not None:
             task.stop()

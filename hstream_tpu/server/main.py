@@ -48,7 +48,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           encode_workers: int = DEFAULT_ENCODE_WORKERS,
           credit_window: int | None = None,
           metrics_port: int | None = None,
-          slow_request_ms: float = 1000.0
+          slow_request_ms: float = 1000.0,
+          faults: str | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -71,6 +72,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         encode_workers=encode_workers,
                         credit_window=credit_window,
                         slow_request_ms=slow_request_ms)
+    if faults:
+        # chaos harness: arm fault sites for this run (same grammar as
+        # HSTREAM_FAULTS, which ServerContext already loaded)
+        ctx.faults.load_env(faults)
     if append_compression:
         from hstream_tpu.store.api import Compression
 
@@ -163,6 +168,11 @@ def _parse_args(argv):
     ap.add_argument("--slow-request-ms", type=float, default=None,
                     help="log a correlated slow-request warning for "
                          "any RPC slower than this (default 1000)")
+    ap.add_argument("--faults", default=None, metavar="SITE=SPEC;...",
+                    help="arm chaos fault sites at boot, e.g. "
+                         "'store.append=fail:3;snapshot.persist="
+                         "torn:2:7' (also: HSTREAM_FAULTS env, admin "
+                         "fault-set at runtime)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -174,7 +184,8 @@ def _parse_args(argv):
                 "encode_workers": DEFAULT_ENCODE_WORKERS,
                 "credit_window": None,
                 "metrics_port": None,
-                "slow_request_ms": 1000.0}
+                "slow_request_ms": 1000.0,
+                "faults": None}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -214,7 +225,8 @@ def main(argv=None) -> None:
         encode_workers=cfg["encode_workers"],
         credit_window=cfg["credit_window"],
         metrics_port=cfg["metrics_port"],
-        slow_request_ms=cfg["slow_request_ms"])
+        slow_request_ms=cfg["slow_request_ms"],
+        faults=cfg["faults"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -27,6 +27,11 @@ class TaskStatus:
     RUNNING = 3
     TERMINATED = 4
     CONNECTION_ABORT = 5
+    # crash-loop breaker verdict (QuerySupervisor): K deaths in W
+    # seconds — the query stays down until an operator RestartQuery.
+    # Rides the wire as a raw value of the open proto3 TaskStatusPB
+    # enum (no regenerated descriptor needed).
+    FAILED = 6
 
 
 # query types (reference PersistentQuery createdTime/queryType)

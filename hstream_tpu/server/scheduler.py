@@ -1,5 +1,4 @@
-"""Query->server assignment in the versioned config store (the task-
-distribution seed, SURVEY §2.3).
+"""Query->server assignment + self-healing supervision.
 
 The reference is single-process here too (every query runs in the one
 server, Handler.hs:373-375); SURVEY's TPU-native column asks for a
@@ -15,12 +14,27 @@ Liveness here is epoch-based (single store, one active server at a
 time — a successor always boots with a higher epoch). A multi-server
 deployment over the replicated store adds heartbeats on the same
 records; the CAS adoption path is unchanged.
+
+``QuerySupervisor`` (ISSUE 8) closes the loop the reference leaves
+open ("task distribution: none" — and a dead query stays dead): a
+query task that dies on an unexpected exception is restarted from its
+last snapshot with jittered exponential backoff, and a crash loop (K
+deaths inside W seconds) opens a breaker — status FAILED, a
+``crash_loop_open`` journal event + gauge — so a deterministic bug
+cannot melt the server with restart storms. Restarts are gated
+through ``adoption_allowed`` like boot adoption, so they shed at
+DEFER under overload.
 """
 
 from __future__ import annotations
 
 import json
+import random
+import threading
+import time
+from collections import deque
 
+from hstream_tpu.common.backoff import jittered_backoff
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.store.versioned import VersionMismatch
 
@@ -100,6 +114,7 @@ def try_adopt(ctx, query_id: str) -> bool:
             ctx.config.put(_key(query_id), mine)
             return True
         except VersionMismatch:
+            _journal_adoption_lost(ctx, query_id)
             return False
     version, raw = cur
     try:
@@ -118,7 +133,10 @@ def try_adopt(ctx, query_id: str) -> bool:
         _journal_adoption(ctx, query_id, owner)
         return True
     except VersionMismatch:
-        return False  # a racing successor won the claim
+        # a racing successor won the claim: journal the stand-down so
+        # an operator can see WHY this server skipped the query
+        _journal_adoption_lost(ctx, query_id)
+        return False
 
 
 def _journal_adoption(ctx, query_id: str, owner: dict) -> None:
@@ -136,6 +154,23 @@ def _journal_adoption(ctx, query_id: str, owner: dict) -> None:
         pass
 
 
+def _journal_adoption_lost(ctx, query_id: str) -> None:
+    events = getattr(ctx, "events", None)
+    if events is None:
+        return
+    try:
+        winner = assignment(ctx, query_id) or {}
+        events.append(
+            "adoption_lost",
+            f"lost the adoption race for query {query_id} to "
+            f"{winner.get('node')} (epoch {winner.get('epoch')}); "
+            f"standing down",
+            query=query_id, winner=winner.get("node"),
+            epoch=ctx.boot_epoch)
+    except Exception:  # noqa: BLE001 — journaling must not block boot
+        pass
+
+
 def assignments(ctx) -> dict[str, dict]:
     """query_id -> owner record (admin/introspection)."""
     out = {}
@@ -147,3 +182,310 @@ def assignments(ctx) -> dict[str, dict]:
         if a is not None:
             out[qid] = a
     return out
+
+
+# ---- self-healing supervision ----------------------------------------------
+
+
+class QuerySupervisor:
+    """Restart dead query tasks from their last snapshot; open a
+    breaker on crash loops.
+
+    State machine per query::
+
+        RUNNING --death--> backoff wait --restart ok--> RUNNING
+                    |                         |
+                    |                    restart failed (counts as a
+                    |                    death; next wait doubles)
+                    v
+        K deaths in W seconds --> FAILED (breaker open) until an
+        operator RestartQuery resets the breaker
+
+    Restarts run on ONE dedicated daemon thread; the wait between
+    attempts is a bounded ``Event.wait`` so shutdown is prompt. Backoff
+    is jittered exponential (seeded RNG — a chaos run replays the same
+    waits), doubling per in-window death: with the default ``BREAKER_K``
+    the wait peaks at ``BACKOFF_BASE_S * 2**(BREAKER_K - 2)`` (2s)
+    because the breaker opens on the next death — ``BACKOFF_CAP_S``
+    only binds when ``BREAKER_K``/``BREAKER_W_S`` are tuned up. Every
+    scheduling decision journals
+    ``query_restart_scheduled`` so an operator can reconstruct the
+    timeline. Restarting is background work: it is gated through
+    ``adoption_allowed``, so under overload a restart defers exactly
+    like boot-time adoption would."""
+
+    BACKOFF_BASE_S = 0.25
+    BACKOFF_CAP_S = 30.0   # reachable only if BREAKER_K is raised
+    BACKOFF_JITTER = 0.25
+    BREAKER_K = 5          # deaths ...
+    BREAKER_W_S = 60.0     # ... within this window open the breaker
+
+    def __init__(self, ctx, *, resume_fn=None, seed: int = 0,
+                 clock=time.monotonic):
+        self.ctx = ctx
+        # set by the servicer once handlers exist (resume = relaunch
+        # from snapshot, the same path RestartQuery uses)
+        self.resume_fn = resume_fn
+        self.clock = clock
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stopped = False
+        # qid -> (due monotonic ts, QueryInfo, attempt#)
+        self._pending: dict[str, tuple[float, object, int]] = {}
+        # restarts currently executing on the supervisor thread:
+        # cancel() waits these out so an operator terminate can never
+        # be raced by a resurrect (marked at pending-pop time so there
+        # is no unmarked window between pop and attempt)
+        self._inflight: set[str] = set()
+        self._inflight_cv = threading.Condition(self._lock)
+        # qid -> recent death timestamps (breaker window)
+        self._deaths: dict[str, deque] = {}
+        self._breaker_open: set[str] = set()
+        self.restarts = 0  # total successful supervisor restarts
+        self._thread: threading.Thread | None = None
+
+    # ---- death intake ------------------------------------------------------
+
+    def note_death(self, info, error: BaseException | None = None) -> None:
+        """Called (from the dying task's thread, or by a failed restart)
+        when a supervised query died unexpectedly. Schedules a restart
+        or opens the crash-loop breaker."""
+        qid = info.query_id
+        now = self.clock()
+        with self._lock:
+            if self._stopped or qid in self._breaker_open:
+                return
+            window = self._deaths.setdefault(
+                qid, deque(maxlen=self.BREAKER_K))
+            window.append(now)
+            recent = [t for t in window if now - t <= self.BREAKER_W_S]
+            if len(recent) >= self.BREAKER_K:
+                self._open_breaker_locked(qid, len(recent))
+                return
+            attempt = len(recent)
+            delay = self._backoff_locked(attempt)
+            self._pending[qid] = (now + delay, info, attempt)
+        self._journal(
+            "query_restart_scheduled",
+            f"query {qid} restart #{attempt} in {delay:.2f}s "
+            f"({type(error).__name__ if error else 'resume failure'})",
+            query=qid, attempt=attempt, delay_s=round(delay, 3),
+            error=type(error).__name__ if error else None)
+        self._ensure_thread()
+        self._wake.set()
+
+    def _backoff_locked(self, attempt: int) -> float:
+        return jittered_backoff(
+            attempt - 1, base=self.BACKOFF_BASE_S,
+            cap=self.BACKOFF_CAP_S, jitter=self.BACKOFF_JITTER,
+            rng=self._rng, floor=0.05)
+
+    def _open_breaker_locked(self, qid: str, deaths: int) -> None:
+        self._breaker_open.add(qid)
+        self._pending.pop(qid, None)
+        log.error("crash loop on query %s (%d deaths in %.0fs); "
+                  "breaker OPEN, status FAILED", qid, deaths,
+                  self.BREAKER_W_S)
+        try:
+            from hstream_tpu.server.persistence import TaskStatus
+
+            self.ctx.persistence.set_query_status(qid, TaskStatus.FAILED)
+        except Exception:  # noqa: BLE001 — breaker must open even if
+            pass           # the status write fails
+        self._journal(
+            "crash_loop_open",
+            f"query {qid} crash-looped ({deaths} deaths in "
+            f"{self.BREAKER_W_S:.0f}s); FAILED until operator restart",
+            query=qid, deaths=deaths, window_s=self.BREAKER_W_S)
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.gauge_set("crash_loop_open", qid, 1.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ---- operator surface --------------------------------------------------
+
+    def _forget_locked(self, qid: str) -> None:
+        self._deaths.pop(qid, None)
+        self._breaker_open.discard(qid)
+        self._pending.pop(qid, None)
+
+    def _drop_breaker_gauge(self, qid: str) -> None:
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.gauge_drop("crash_loop_open", qid)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def reset(self, qid: str) -> None:
+        """Forget the death history and close the breaker so
+        supervision starts fresh. Non-blocking — callers that must not
+        race an executing restart use :meth:`cancel`."""
+        with self._lock:
+            self._forget_locked(qid)
+        self._drop_breaker_gauge(qid)
+
+    def cancel(self, qid: str) -> None:
+        """Query terminated/deleted/operator-restarted: drop any
+        pending restart, wait out one already executing on the
+        supervisor thread, and forget the death history — with no
+        window in which the restart loop could dispatch a fresh
+        attempt. The caller's terminate/restart thus always runs AFTER
+        any resurrect, so the task it finds in running_queries is the
+        final one."""
+        deadline = time.monotonic() + 30.0
+        with self._inflight_cv:
+            # pop FIRST so a due pending entry cannot dispatch while
+            # we wait; re-pop after each wakeup to drop requeues made
+            # by the in-flight attempt (corpse / defer paths)
+            self._pending.pop(qid, None)
+            while (qid in self._inflight
+                   and time.monotonic() < deadline):
+                self._inflight_cv.wait(timeout=0.25)
+                self._pending.pop(qid, None)
+            if qid in self._inflight:
+                log.warning("cancel(%s): in-flight supervised restart "
+                            "did not finish within 30s", qid)
+            # same lock hold as the final inflight/pending check: the
+            # loop cannot pop-and-dispatch in between
+            self._forget_locked(qid)
+        self._drop_breaker_gauge(qid)
+
+    def status(self) -> dict:
+        with self._lock:
+            now = self.clock()
+            return {
+                "restarts": self.restarts,
+                "pending": {qid: {"due_in_s": round(due - now, 3),
+                                  "attempt": attempt}
+                            for qid, (due, _i, attempt)
+                            in self._pending.items()},
+                "breaker_open": sorted(self._breaker_open),
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._pending.clear()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+
+    # ---- restart thread ----------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None and not self._stopped:
+                self._thread = threading.Thread(
+                    target=self._restart_loop, name="query-supervisor",
+                    daemon=True)
+                self._thread.start()
+
+    def _restart_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                now = self.clock()
+                due = [(qid, info, attempt)
+                       for qid, (t, info, attempt)
+                       in self._pending.items() if t <= now]
+                for qid, _i, _a in due:
+                    self._pending.pop(qid, None)
+                    self._inflight.add(qid)
+                wait = min((t - now for t, _i, _a
+                            in self._pending.values()), default=None)
+            for qid, info, attempt in due:
+                try:
+                    self._attempt_restart(qid, info, attempt)
+                except Exception as e:  # noqa: BLE001 — this thread is
+                    # the singleton supervisor: an escaped bug in one
+                    # attempt must count as another death (backoff +
+                    # breaker), never kill supervision for every query
+                    log.exception("supervised restart attempt for %s "
+                                  "blew up", qid)
+                    try:
+                        self.note_death(info, e)
+                    except Exception:  # noqa: BLE001
+                        pass
+                finally:
+                    with self._lock:
+                        self._inflight.discard(qid)
+                        self._inflight_cv.notify_all()
+            # nothing pending: block until a death/requeue wakes us
+            # (requeue paths set _wake, so the stale `wait` computed
+            # before the attempts above cannot strand a new entry)
+            self._wake.wait(timeout=None if wait is None
+                            else max(min(wait, 0.5), 0.01))
+            self._wake.clear()
+
+    def _attempt_restart(self, qid: str, info, attempt: int) -> None:
+        ctx = self.ctx
+        from hstream_tpu.server.persistence import TaskStatus
+
+        stale = ctx.running_queries.get(qid)
+        if stale is not None:
+            if getattr(stale, "error", None) is not None:
+                # the dead task is still tearing down (its finally
+                # joins reader/persist threads, which can hold it past
+                # our backoff) — it pops running_queries last, so retry
+                # shortly instead of mistaking the corpse for a live
+                # operator-owned task and dropping the restart forever
+                with self._lock:
+                    if not self._stopped \
+                            and qid not in self._breaker_open:
+                        self._pending[qid] = (self.clock() + 0.25,
+                                              info, attempt)
+                self._wake.set()
+                return
+            return  # an operator beat us to it
+        try:
+            fresh = ctx.persistence.get_query(qid)
+        except Exception:  # noqa: BLE001 — deleted while pending
+            return
+        if fresh.status in (TaskStatus.TERMINATED, TaskStatus.FAILED):
+            return  # terminated (or breaker opened) while pending
+        if not adoption_allowed(ctx, qid):
+            # overload: defer like boot adoption — same slot, later due
+            with self._lock:
+                if not self._stopped and qid not in self._breaker_open:
+                    self._pending[qid] = (self.clock() + 1.0, info,
+                                          attempt)
+            self._wake.set()
+            return
+        resume = self.resume_fn
+        if resume is None:
+            log.warning("no resume_fn bound; dropping restart of %s",
+                        qid)
+            return
+        try:
+            resume(info)
+            ctx.persistence.set_query_status(qid, TaskStatus.RUNNING)
+        except Exception as e:  # noqa: BLE001 — a failed restart is
+            # another death: backoff doubles, the breaker counts it
+            log.exception("supervised restart of %s failed", qid)
+            self.note_death(info, e)
+            return
+        with self._lock:
+            self.restarts += 1
+        log.info("supervisor restarted query %s (attempt %d)", qid,
+                 attempt)
+        stats = getattr(ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.stream_stat_add("query_restarts", qid)
+            except Exception:  # noqa: BLE001 — metrics must not stop
+                pass           # the restart
+
+    def _journal(self, kind: str, message: str, **fields) -> None:
+        events = getattr(self.ctx, "events", None)
+        if events is None:
+            return
+        try:
+            events.append(kind, message, **fields)
+        except Exception:  # noqa: BLE001 — journaling is best-effort
+            pass

@@ -21,6 +21,7 @@ after the last snapshot.
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
@@ -31,12 +32,15 @@ import numpy as np
 
 from hstream_tpu.common import columnar, jsondec
 from hstream_tpu.common import records as rec
+from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.common.tracing import QueryTracer, trace_span
 from hstream_tpu.engine.pipeline import IngestPipeline
 from hstream_tpu.engine.snapshot import (
     capture_executor,
+    open_blob,
     restore_executor,
+    seal_blob,
     serialize_capture,
 )
 from hstream_tpu.server.context import (
@@ -58,8 +62,35 @@ PREFETCH_BATCHES = 2  # read-ahead depth of the reader prefetch thread
 
 
 def snapshot_key(query_id: str) -> str:
-    """Meta-KV key holding a query's operator-state snapshot."""
+    """Meta-KV key holding a query's operator-state snapshot: either a
+    legacy raw npz blob (pre-ISSUE 8 servers) or a pointer to the
+    current slot of the two-slot rotation."""
     return f"qsnap/{query_id}"
+
+
+def snapshot_slot_key(query_id: str, slot: int) -> str:
+    """One slot of the two-slot last-good snapshot rotation."""
+    return f"qsnap/{query_id}@{slot}"
+
+
+# pointer payload: magic + JSON {"slot": 0|1}. Written AFTER the slot
+# blob, so a crash (or torn write) between the two leaves the pointer
+# at the previous good slot.
+SNAP_PTR_MAGIC = b"HSPTR1"
+
+
+def parse_snapshot_pointer(raw: bytes) -> int | None:
+    """Slot named by a two-slot rotation pointer, or None when ``raw``
+    is not a pointer (legacy direct blob). A corrupt pointer parses to
+    slot 0 — restore walks both slots anyway. The ONE place pointer
+    bytes are interpreted: restore and the admin `snapshots` verb must
+    never disagree on which slot is current."""
+    if not raw.startswith(SNAP_PTR_MAGIC):
+        return None
+    try:
+        return int(json.loads(raw[len(SNAP_PTR_MAGIC):])["slot"]) & 1
+    except (ValueError, KeyError, TypeError):
+        return 0
 
 
 class QueryTask(threading.Thread):
@@ -135,6 +166,14 @@ class QueryTask(threading.Thread):
         self._dirty = False
         self._crash = False
         self._detach = False
+        # two-slot snapshot rotation: next slot to write (restore sets
+        # it to the OTHER slot than the one it loaded, so the last
+        # known-good snapshot is never the one being overwritten)
+        self._snap_slot = 0
+        # device-fallback mirror: engine executors count activations
+        # that degraded to the host reference path on themselves;
+        # deltas land in the device_path_fallbacks counter
+        self._dev_fallback_seen = 0
 
     def _observe_stage(self, stage: str, seconds: float) -> None:
         stats = getattr(self.ctx, "stats", None)
@@ -151,6 +190,15 @@ class QueryTask(threading.Thread):
                 events.append(kind, message, **fields)
             except Exception:  # noqa: BLE001
                 pass
+
+    def _count_stat(self, metric: str) -> None:
+        """Bump a per-query counter (label = query id); never fatal."""
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.stream_stat_add(metric, self.info.query_id)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # recovery paths
 
     def _note_decode(self, metric: str, logid: int, n: int) -> None:
         """Count records through the native libjsondec batch decoder vs
@@ -239,6 +287,9 @@ class QueryTask(threading.Thread):
                     # latency EWMA so the shed level recovers
                     self._feed_flow_signals(0.0)
                     continue
+                if FAULTS.active:  # chaos: crash mid-batch — the chunk
+                    # is read but neither processed nor checkpointed
+                    FAULTS.point("task.step")
                 t_step = time.perf_counter()
                 self._ingest_results(results)
                 self._feed_flow_signals(time.perf_counter() - t_step)
@@ -271,6 +322,15 @@ class QueryTask(threading.Thread):
                                                  TaskStatus.CONNECTION_ABORT)
             except Exception:
                 pass
+            # self-healing: hand the death to the supervisor UNLESS a
+            # stop was requested (an operator stop racing an error must
+            # not resurrect the query)
+            sup = getattr(ctx, "supervisor", None)
+            if sup is not None and not self._stop_ev.is_set():
+                try:
+                    sup.note_death(self.info, e)
+                except Exception:  # noqa: BLE001 — supervision must
+                    pass           # not mask the original death
         finally:
             t = self._read_thread
             if t is not None:
@@ -319,6 +379,7 @@ class QueryTask(threading.Thread):
         per-chunk step latency every chunk (an EWMA update, cheap), and
         pipeline occupancy + reorder-ring depth at ~1 Hz (stats() walks
         the stage rings)."""
+        self._note_device_fallbacks()
         flow = getattr(self.ctx, "flow", None)
         if flow is None:
             return
@@ -346,18 +407,105 @@ class QueryTask(threading.Thread):
         det.note("reorder_depth",
                  pipe.pending / max(self.pipeline_depth, 1), source=qid)
 
+    def _note_device_fallbacks(self) -> None:
+        """Mirror engine-side device->host path degradations (join
+        activation / fused close falling back to the reference path)
+        into the device_path_fallbacks counter, labeled by the primary
+        source stream. Delta-based, called once per chunk/idle tick."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return
+        cur = int(getattr(ex, "device_fallbacks", 0))
+        inner = getattr(ex, "_inner", None)
+        if inner is not None:
+            cur += int(getattr(inner, "device_fallbacks", 0))
+        delta = cur - self._dev_fallback_seen
+        if delta <= 0:
+            return
+        self._dev_fallback_seen = cur
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.stream_stat_add("device_path_fallbacks",
+                                      self.plan.source, delta)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the ingest loop
+
     # ---- operator-state checkpointing --------------------------------------
+
+    def _snapshot_candidates(self) -> list[tuple[str, bytes]]:
+        """(label, sealed bytes) restore candidates, best first: the
+        pointed-at slot, then the other slot (the previous good
+        snapshot), or the single legacy blob."""
+        qid = self.info.query_id
+        raw = self.ctx.store.meta_get(snapshot_key(qid))
+        if raw is None:
+            return []
+        slot = parse_snapshot_pointer(raw)
+        if slot is None:
+            return [("legacy", raw)]
+        out = []
+        for s in (slot, 1 - slot):
+            data = self.ctx.store.meta_get(snapshot_slot_key(qid, s))
+            if data is not None:
+                out.append((f"slot {s}", data))
+        return out
 
     def _restore_state(self) -> dict[int, int] | None:
         """Restore executor + sink state from the last snapshot. Returns
         the read positions the state corresponds to (logid -> committed
-        LSN), or None when starting fresh."""
-        blob = self.ctx.store.meta_get(snapshot_key(self.info.query_id))
-        if blob is None:
+        LSN), or None when starting fresh.
+
+        Integrity hardening (ISSUE 8): snapshot blobs are CRC-sealed
+        and written to a two-slot rotation. A corrupt/torn newest slot
+        journals ``snapshot_corrupt``, bumps ``snapshot_fallbacks`` and
+        falls back to the previous good slot — restoring older state +
+        its paired (older) checkpoints, so the gap REPLAYS instead of
+        the query dying at boot. When every candidate is corrupt the
+        checkpoints are removed too (rewind to the trim point) — a
+        fresh aggregation beats a boot failure, and beats silently
+        skipping the span the lost state covered."""
+        qid = self.info.query_id
+        candidates = self._snapshot_candidates()
+        if not candidates:
+            return None
+        ex = extra = None
+        for i, (label, sealed) in enumerate(candidates):
+            try:
+                blob = open_blob(sealed)
+                if FAULTS.active:  # chaos: provoke a restore failure
+                    FAULTS.point("snapshot.restore")
+                with self.state_lock:
+                    ex, extra = restore_executor(
+                        self.plan, blob, mesh=self._query_mesh())
+            except Exception as e:  # noqa: BLE001 — corrupt blob,
+                # injected fault, or a restore bug: fall back rather
+                # than die at boot
+                log.error("query %s: snapshot %s unrestorable (%s); "
+                          "falling back", qid, label, e)
+                self._journal(
+                    "snapshot_corrupt",
+                    f"query {qid}: snapshot {label} unrestorable "
+                    f"({type(e).__name__}: {e})",
+                    query=qid, candidate=label, error=type(e).__name__)
+                self._count_stat("snapshot_fallbacks")
+                continue
+            if label.startswith("slot"):
+                # next persist must overwrite the OTHER slot, keeping
+                # the one that just proved restorable
+                self._snap_slot = 1 - int(label.split()[1])
+            break
+        if ex is None:
+            # every candidate corrupt: rewind-from-trim-point — drop
+            # the checkpoint mirror so the reader starts at its
+            # fallback LSN and re-aggregates
+            log.error("query %s: NO restorable snapshot (%d candidates)"
+                      "; rewinding to trim point", qid, len(candidates))
+            if self._reader is not None:
+                self._reader.remove_checkpoints()
             return None
         with self.state_lock:
-            ex, extra = restore_executor(
-                self.plan, blob, mesh=self._query_mesh())
             self.executor = self._tune_executor(ex)
             if self.sink_load is not None and "sink" in extra:
                 self.sink_load(extra["sink"])
@@ -520,9 +668,22 @@ class QueryTask(threading.Thread):
                     self._persist_cv.notify_all()
 
     def _persist_capture(self, meta, arrays, ckps: dict[int, int]) -> None:
+        """Write one CRC-sealed snapshot into the two-slot rotation:
+        slot blob first, pointer second. A crash or torn write anywhere
+        in between leaves the pointer at the previous good slot, so
+        restore never sees a half-written snapshot as newest-truth."""
         t0 = time.monotonic()
-        blob = serialize_capture(meta, arrays)
-        self.ctx.store.meta_put(snapshot_key(self.info.query_id), blob)
+        qid = self.info.query_id
+        sealed = seal_blob(serialize_capture(meta, arrays))
+        if FAULTS.active:  # chaos: injected persist failure/torn write
+            FAULTS.point("snapshot.persist")
+            sealed = FAULTS.mutate("snapshot.persist", sealed)
+        slot = self._snap_slot & 1
+        self.ctx.store.meta_put(snapshot_slot_key(qid, slot), sealed)
+        self.ctx.store.meta_put(
+            snapshot_key(qid),
+            SNAP_PTR_MAGIC + json.dumps({"slot": slot}).encode())
+        self._snap_slot = 1 - slot
         if self._reader is not None and ckps:
             self._reader.write_checkpoints(ckps)
         self._last_persist_ms = (time.monotonic() - t0) * 1000

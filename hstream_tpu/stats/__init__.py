@@ -40,6 +40,13 @@ PER_STREAM_COUNTERS = [
     "kernel_recompiles",       # XLA executable builds observed by the
                                # process-wide RetraceGuard listener
                                # (contract: zero in steady state)
+    "query_restarts",          # supervisor-initiated query restarts
+                               # (label: query id)
+    "snapshot_fallbacks",      # restores that fell back past a corrupt
+                               # snapshot slot (label: query id)
+    "device_path_fallbacks",   # device-join / fused-close activations
+                               # that degraded to the host reference
+                               # path (label: source stream)
 ]
 
 PER_STREAM_TIME_SERIES = [
@@ -66,6 +73,8 @@ GAUGES = [
     "store_wal_bytes",        # durable store write-ahead-log footprint
     "running_queries",        # live query tasks on this server
     "event_journal_size",     # entries currently held by the journal
+    "crash_loop_open",        # per query: 1 while the supervisor's
+                              # crash-loop breaker holds it FAILED
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);

@@ -32,6 +32,12 @@ EVENT_KINDS = [
     "query_restarted",   # operator RestartQuery
     "query_died",        # task hit CONNECTION_ABORT
     "snapshot_failed",   # background state persist failed
+    "query_restart_scheduled",  # supervisor queued a restart (backoff)
+    "crash_loop_open",   # K failures in W seconds -> breaker FAILED
+    "snapshot_corrupt",  # restore skipped a corrupt snapshot slot
+    "checkpoint_corrupt",  # checkpoint store recovered from bad bytes
+    "fault_injected",    # a chaos fault site fired
+    "adoption_lost",     # lost the CAS race adopting a query
 ]
 
 

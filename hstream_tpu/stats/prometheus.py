@@ -30,6 +30,14 @@ from hstream_tpu.stats import (
 
 PREFIX = "hstream"
 
+# counters whose series label is a QUERY id, not a stream name: they
+# live outside the stream namespace, so the live-stream filter must
+# not drop them (same rationale as "_"-prefixed pseudo-streams). A
+# restart/fallback series for a crash-looped (FAILED, detached) query
+# especially must survive the scrape — it is the evidence an operator
+# scrapes FOR.
+QUERY_LABEL_COUNTERS = frozenset({"query_restarts", "snapshot_fallbacks"})
+
 _HELP = {
     "append_payload_bytes": "bytes appended (payload only)",
     "append_total": "append batches accepted",
@@ -49,6 +57,11 @@ _HELP = {
                             "sink columnar (no per-row dicts)",
     "kernel_recompiles": "XLA executable builds observed at runtime "
                          "(zero in steady state)",
+    "query_restarts": "supervisor-initiated query restarts",
+    "snapshot_fallbacks": "restores that skipped a corrupt snapshot "
+                          "slot for the previous good one",
+    "device_path_fallbacks": "device kernel activations degraded to "
+                             "the host reference path",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",
@@ -62,6 +75,8 @@ _HELP = {
     "store_wal_bytes": "durable store write-ahead-log bytes on disk",
     "running_queries": "live query tasks on this server",
     "event_journal_size": "entries held by the event journal",
+    "crash_loop_open": "1 while the crash-loop breaker holds a query "
+                       "FAILED",
     "append_latency_ms": "Append RPC latency",
     "fetch_latency_ms": "Fetch RPC latency",
     "sql_execute_latency_ms": "ExecuteQuery RPC latency",
@@ -98,10 +113,14 @@ def _header(lines: list[str], name: str, mtype: str, help_key: str
     lines.append(f"# TYPE {name} {mtype}")
 
 
-def render_holder(stats, *, live_streams=None) -> str:
+def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
     """Exposition text for one StatsHolder: counters (`_total`), rates
     (gauge), gauges, histograms. `live_streams` (optional set) filters
-    counter/rate series to streams that still exist, like GetStats."""
+    counter/rate series to streams that still exist, like GetStats;
+    `live_queries` (optional set of query ids, ANY status — a
+    crash-looped FAILED query must keep its evidence) likewise bounds
+    the QUERY_LABEL_COUNTERS series so deleted queries don't grow the
+    exposition forever."""
     lines: list[str] = []
     for metric in PER_STREAM_COUNTERS:
         name = f"{PREFIX}_{metric}" \
@@ -109,12 +128,19 @@ def render_holder(stats, *, live_streams=None) -> str:
         _header(lines, name, "counter", metric)
         for stream, v in sorted(stats.stream_stat_getall(metric).items()):
             # "_"-prefixed labels are process-scoped pseudo-streams
-            # (kernel_recompiles{stream="_process"}): they are not in
-            # the stream namespace, so the liveness filter must not
-            # drop them
-            if (live_streams is not None and stream not in live_streams
-                    and not stream.startswith("_")):
-                continue
+            # (kernel_recompiles{stream="_process"}) and
+            # QUERY_LABEL_COUNTERS series are labeled by query id:
+            # neither is in the stream namespace, so the STREAM
+            # liveness filter must not drop them — query-labeled
+            # series are bounded by query existence instead
+            if not stream.startswith("_"):
+                if metric in QUERY_LABEL_COUNTERS:
+                    if (live_queries is not None
+                            and stream not in live_queries):
+                        continue
+                elif (live_streams is not None
+                        and stream not in live_streams):
+                    continue
             lines.append(_series(name, {"stream": stream}, v))
     for metric, _levels in PER_STREAM_TIME_SERIES:
         name = f"{PREFIX}_{metric}_rate"
@@ -157,7 +183,7 @@ def render_holder(stats, *, live_streams=None) -> str:
 
 
 def _gauge_label_key(metric: str) -> str:
-    if metric.startswith("pipeline_"):
+    if metric.startswith("pipeline_") or metric == "crash_loop_open":
         return "query"
     if metric in ("sub_backlog", "credit_inflight"):
         return "subscription"
@@ -296,7 +322,13 @@ def render_metrics(ctx) -> str:
             live = set(ctx.streams.find_streams())
         except Exception:  # noqa: BLE001
             live = None
-        return render_holder(ctx.stats, live_streams=live)
+        try:
+            queries = {q.query_id
+                       for q in ctx.persistence.get_queries()}
+        except Exception:  # noqa: BLE001 — fail open, like streams
+            queries = None
+        return render_holder(ctx.stats, live_streams=live,
+                             live_queries=queries)
 
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -16,6 +16,8 @@ import json
 import os
 import threading
 
+from hstream_tpu.common.faultinject import FAULTS
+from hstream_tpu.common.logger import get_logger
 from hstream_tpu.store.api import (
     LSN_MAX,
     LSN_MIN,
@@ -26,6 +28,8 @@ from hstream_tpu.store.api import (
     ReadResult,
 )
 from hstream_tpu.store.streams import CHECKPOINT_STORE_LOGID, StreamApi
+
+log = get_logger("checkpoint")
 
 
 class MemCheckpointStore(CheckpointStore):
@@ -51,20 +55,49 @@ class MemCheckpointStore(CheckpointStore):
 
 
 class FileCheckpointStore(CheckpointStore):
-    """One JSON file per root path; atomic replace on update."""
+    """One JSON file per root path; atomic replace on update.
+
+    A truncated or torn file (the atomic replace protects against torn
+    *replaces*, not a torn write of a pre-atomic-era file or filesystem
+    corruption) must not prevent construction — and therefore server
+    boot (ISSUE 8). Recovery degrades to an EMPTY store: readers rewind
+    to their fallback start (the trim point), replaying at-least-once
+    instead of crashing. The corrupt bytes are preserved next to the
+    path (``<path>.corrupt``) and ``load_error`` records what happened
+    so the owner can journal a ``checkpoint_corrupt`` event."""
 
     def __init__(self, path: str):
         self._path = path
         self._lock = threading.Lock()
         self._data: dict[str, dict[str, int]] = {}
+        self.load_error: str | None = None
         if os.path.exists(path):
-            with open(path) as f:
-                self._data = json.load(f)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    raise ValueError(
+                        f"checkpoint root is {type(data).__name__}, "
+                        f"not an object")
+                self._data = data
+            except (ValueError, OSError) as e:
+                self.load_error = f"{type(e).__name__}: {e}"
+                log.error(
+                    "checkpoint file %s is corrupt (%s); recovering "
+                    "empty — readers rewind to their trim points",
+                    path, self.load_error)
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
 
     def _flush(self) -> None:
         tmp = self._path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._data, f)
+        data = json.dumps(self._data).encode()
+        # chaos probe: a torn flush truncates the JSON mid-document
+        data = FAULTS.mutate("checkpoint.flush", data)
+        with open(tmp, "wb") as f:
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
@@ -106,6 +139,12 @@ class LogCheckpointStore(CheckpointStore):
         self._data: dict[str, dict[int, int]] = {}
         self._deltas = 0
         self._compact_every = compact_every
+        # entries the boot replay could not decode/apply (corrupt or
+        # torn deltas): skipped loudly instead of failing boot; the
+        # ServerContext journals a checkpoint_corrupt event when > 0.
+        # A skipped delta can only LOWER a customer's checkpoint, so
+        # its reader replays more — at-least-once, never a skip.
+        self.replay_skipped = 0
         StreamApi(store).ensure_checkpoint_log()
         self._replay()
 
@@ -121,7 +160,14 @@ class LogCheckpointStore(CheckpointStore):
                 if not isinstance(r, DataBatch):
                     continue
                 for payload in r.payloads:
-                    self._apply(json.loads(payload))
+                    try:
+                        self._apply(json.loads(payload))
+                    except (ValueError, KeyError, TypeError,
+                            AttributeError) as e:
+                        self.replay_skipped += 1
+                        log.error(
+                            "skipping corrupt checkpoint entry at "
+                            "lsn %d: %s", r.lsn, e)
         reader.stop_reading(self._logid)
 
     def _apply(self, entry: dict) -> None:
@@ -138,7 +184,10 @@ class LogCheckpointStore(CheckpointStore):
                 cur[int(k)] = v
 
     def _append(self, entry: dict) -> None:
-        self._store.append(self._logid, json.dumps(entry).encode())
+        data = json.dumps(entry).encode()
+        # chaos probe: torn delta write / injected append failure
+        data = FAULTS.mutate("checkpoint.flush", data)
+        self._store.append(self._logid, data)
         self._deltas += 1
         if self._deltas >= self._compact_every:
             snap = {"snap": {c: {str(k): v for k, v in m.items()}

@@ -15,6 +15,7 @@ import time
 from typing import Sequence
 
 from hstream_tpu.common.errors import LogNotFound, StoreError
+from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.store.api import (
     LSN_INVALID,
     LSN_MAX,
@@ -86,6 +87,8 @@ class MemLogStore(LogStore):
                      append_time_ms: int | None = None) -> int:
         if not payloads:
             raise StoreError("empty batch")
+        if FAULTS.active:  # chaos probe; one branch when disarmed
+            FAULTS.point("store.append")
         with self._data_cond:
             log = self._get(logid)
             lsn = log.next_lsn
@@ -213,6 +216,8 @@ class MemLogReader(LogReader):
         return out
 
     def read(self, max_records: int) -> list[ReadResult]:
+        if FAULTS.active:  # chaos probe; one branch when disarmed
+            FAULTS.point("store.read")
         deadline = None
         if self._timeout_ms >= 0:
             deadline = time.monotonic() + self._timeout_ms / 1000.0

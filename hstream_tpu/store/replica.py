@@ -31,6 +31,7 @@ election is the cluster scheduler's concern, not the storage layer's.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 import uuid
@@ -39,7 +40,9 @@ from typing import Sequence
 
 import grpc
 
+from hstream_tpu.common.backoff import jittered_backoff
 from hstream_tpu.common.errors import StoreIOError
+from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.proto.rpc import StoreReplicaStub, add_store_replica_to_server
@@ -51,7 +54,13 @@ log = get_logger("replica")
 OPLOG_ID = (1 << 61) + 7
 
 _ACK_TIMEOUT_S = 5.0
-_RETRY_S = 1.0
+# follower reconnect backoff: jittered exponential from _RETRY_S up to
+# _RETRY_CAP_S — a flapping follower must not spin the leader's sender
+# thread hot (ISSUE 8); reset only once a Replicate is ACKED (a peer
+# that merely accepts connections keeps backing off)
+_RETRY_S = 0.2
+_RETRY_CAP_S = 5.0
+_RETRY_JITTER = 0.25
 
 
 def _encode_entry(e: pb.LogEntry) -> bytes:
@@ -68,6 +77,8 @@ def _apply(store: LogStore, e: pb.LogEntry) -> None:
     re-applying an entry after a crash in the apply/log window is a
     no-op (appends are guarded by expect_lsn; the other ops are
     naturally idempotent)."""
+    if FAULTS.active:  # chaos probe; one branch when disarmed
+        FAULTS.point("store.oplog.apply")
     if e.op == pb.OP_APPEND:
         if e.expect_lsn and store.tail_lsn(e.logid) >= e.expect_lsn:
             return  # already applied (crash between apply and log)
@@ -140,16 +151,37 @@ class _Follower:
         self.owner = owner
         self.acked_seq = 0
         self.alive = False
+        # reconnect backoff state: attempt count since the last ACKED
+        # Replicate (not merely the last good connect) + the wait the
+        # next failure will schedule (tests assert growth and the
+        # cap). Jitter is seeded per follower so a chaos run replays
+        # the same wait sequence.
+        self.connect_attempts = 0
+        self.last_backoff_s = 0.0
+        self._jitter = random.Random(addr)
         self._thread = threading.Thread(
             target=self._run, name=f"repl-{addr}", daemon=True)
 
     def start(self) -> None:
         self._thread.start()
 
+    def _backoff(self) -> float:
+        """Jittered exponential reconnect wait: base * 2^attempt capped
+        at _RETRY_CAP_S, +/- _RETRY_JITTER so a fleet of senders
+        doesn't reconnect in lockstep."""
+        wait = jittered_backoff(
+            self.connect_attempts, base=_RETRY_S, cap=_RETRY_CAP_S,
+            jitter=_RETRY_JITTER, rng=self._jitter)
+        self.connect_attempts += 1
+        self.last_backoff_s = wait
+        return wait
+
     def _run(self) -> None:
         owner = self.owner
         while not owner._stop.is_set():
             try:
+                if FAULTS.active:  # chaos: provoke a connect failure
+                    FAULTS.point("store.follower.connect")
                 with grpc.insecure_channel(self.addr) as ch:
                     stub = StoreReplicaStub(ch)
                     info = stub.ReplicaInfo(pb.ReplicaInfoRequest(),
@@ -184,7 +216,7 @@ class _Follower:
                 self.alive = False
                 with owner._cond:
                     owner._cond.notify_all()
-                if owner._stop.wait(_RETRY_S):
+                if owner._stop.wait(self._backoff()):
                     return
         self.alive = False
 
@@ -232,6 +264,8 @@ class _Follower:
                 if not entries:
                     continue
                 pos = entries[-1].seq + 1
+                if FAULTS.active:  # chaos: drop the ack RPC
+                    FAULTS.point("store.follower.ack")
                 resp = stub.Replicate(
                     pb.ReplicateRequest(entries=entries,
                                         leader_id=owner.node_id),
@@ -240,6 +274,12 @@ class _Follower:
                 # applied seq rewinds the stream (e.g. it restarted
                 # from older disk)
                 self.acked_seq = resp.applied_seq
+                # real streaming progress: only now does the reconnect
+                # schedule start over — a half-broken peer that answers
+                # ReplicaInfo but fails every Replicate must keep
+                # backing off, not retry at the floor forever
+                self.connect_attempts = 0
+                self.last_backoff_s = 0.0
                 with owner._cond:
                     owner._cond.notify_all()
         finally:

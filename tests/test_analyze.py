@@ -249,6 +249,33 @@ def test_blocking_thread_run_covered_and_bounded_ok():
     assert len(out) == 1 and "W.run" in out[0].message
 
 
+def test_blocking_supervisor_backoff_sleep_carved_out():
+    """ISSUE 8 carve-out: a *Supervisor class's restart thread OWNS its
+    latency budget — backoff time.sleep between restart attempts is
+    sanctioned. Every OTHER blocking call in the supervisor is still
+    flagged, and the same sleep in a non-Supervisor worker stays hot."""
+    code = '''
+    import time
+
+    class QuerySupervisor:
+        def _restart_loop(self):
+            while True:
+                time.sleep(0.5)   # backoff between attempts: OK
+                self._q.get()     # unbounded wait: still flagged
+
+    class RetryWorker:
+        def _restart_loop(self):
+            time.sleep(0.5)       # no Supervisor suffix: flagged
+    '''
+    out = run_one(blocking, [src("m.py", code)])
+    msgs = sorted(f.message for f in out)
+    assert len(out) == 2, msgs
+    assert "time.sleep" in msgs[0]
+    assert "RetryWorker._restart_loop" in msgs[0]
+    assert "unbounded get()" in msgs[1]
+    assert "QuerySupervisor._restart_loop" in msgs[1]
+
+
 # ---- purity ----------------------------------------------------------------
 
 

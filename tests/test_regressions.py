@@ -618,8 +618,13 @@ def test_migrate_store_int32_span_guard():
     """overflow-narrowing fix: device activation migrates host stores
     with `(st.ts - t0).astype(np.int32)` — the host store's 2^41 span
     guard allows ranges int32 cannot hold, so a join whose retention
-    spans > 2^31 ms must fail LOUDLY at activation instead of silently
-    wrapping every probe bound."""
+    spans > 2^31 ms must trip the guard at activation instead of
+    silently wrapping every probe bound. Since ISSUE 8 the tripped
+    guard degrades the QUERY to the retained host reference path
+    (which allows the full 2^41 span exactly) rather than killing it:
+    `_activate_device` still raises SQLCodegenError loudly, but
+    `_device_ready` catches it, counts device_fallbacks, and the join
+    keeps producing correct results on the host path."""
     from hstream_tpu.common.errors import SQLCodegenError
     from tests.test_join_device import BASE, make_join
 
@@ -635,10 +640,24 @@ def test_migrate_store_int32_span_guard():
     ex.process(rows, [BASE + (1 << 31) + 500_000], stream="l")
     # first match builds the inner executor and plans the fast path
     ex.process(rows, [BASE + (1 << 31) + 600_000], stream="r")
+    # the migration layer fails LOUDLY on the un-narrowable span ...
+    fast = ex._fast_info()
+    assert fast is not None, "fast path did not plan"
     with pytest.raises(SQLCodegenError, match="int32"):
-        # the next batch activates the device stores — migration must
-        # fail loudly on the un-narrowable span
-        ex.process(rows, [BASE + (1 << 31) + 700_000], stream="r")
+        ex._activate_device(fast)
+    # activation is not exception-atomic; _device_ready's except
+    # clause owns the cleanup on the real path — undo the partial
+    # activation so the retry below goes through it
+    ex._dev = None
+    # ... and the query layer degrades to the host path instead of
+    # dying: the next batch retries activation through _device_ready,
+    # catches the guard, and carries on exactly
+    out = ex.process(rows, [BASE + (1 << 31) + 700_000], stream="r")
+    assert ex.device_fallbacks == 1
+    assert ex.use_device_join is False and ex._dev is None
+    assert out is not None
+    ex.process(rows, [BASE + (1 << 31) + 800_000], stream="r")
+    assert ex.device_fallbacks == 1  # no re-activation attempts
 
 
 def test_measure_rtt_jit_is_memoized():
@@ -654,3 +673,254 @@ def test_measure_rtt_jit_is_memoized():
         bench.measure_rtt()
         bench.measure_rtt()
     assert g.count == 0, "measure_rtt retraced after warmup"
+
+
+# ---- ISSUE 8: fault injection + self-healing hardening ----------------------
+
+
+def test_file_checkpoint_store_corrupt_json_recovers_boot(tmp_path):
+    """FileCheckpointStore.__init__ did a bare json.load — a truncated
+    or torn file raised at construction and prevented server boot. It
+    must now recover to an EMPTY store (readers rewind to their trim
+    points), preserve the corrupt bytes next to the path, and record
+    load_error so the owner can journal checkpoint_corrupt."""
+    from hstream_tpu.store import FileCheckpointStore
+
+    path = str(tmp_path / "ckp.json")
+    torn = b'{"query-q1": {"7": 123}, "query-q2": {"8"'
+    with open(path, "wb") as f:
+        f.write(torn)
+    st = FileCheckpointStore(path)  # must NOT raise
+    assert st.load_error is not None
+    assert st.get("query-q1", 7) is None  # empty: rewind, not guess
+    with open(path + ".corrupt", "rb") as f:
+        assert f.read() == torn  # forensic copy preserved
+    # the store works after recovery and persists durably again
+    st.update("query-q1", 7, 55)
+    assert FileCheckpointStore(path).get("query-q1", 7) == 55
+
+
+def test_file_checkpoint_store_non_dict_root_recovers(tmp_path):
+    """A valid-JSON-but-wrong-shape file (e.g. a list) is corruption
+    too: recover empty instead of exploding on the first .items()."""
+    from hstream_tpu.store import FileCheckpointStore
+
+    path = str(tmp_path / "ckp.json")
+    with open(path, "w") as f:
+        f.write("[1, 2, 3]")
+    st = FileCheckpointStore(path)
+    assert st.load_error is not None
+    assert st.get("query-x", 1) is None
+
+
+def test_follower_reconnect_backoff_grows_jittered_capped():
+    """_Follower._run retried a dead peer every fixed 1s — a flapping
+    follower now gets jittered exponential backoff: strictly growing
+    waits (2x steps beat the 25% jitter), a hard cap, a seeded
+    per-address jitter stream (chaos runs replay identical waits), and
+    a reset once a connect succeeds."""
+    from hstream_tpu.store.replica import (
+        _RETRY_CAP_S,
+        _RETRY_JITTER,
+        _RETRY_S,
+        _Follower,
+    )
+
+    f = _Follower("127.0.0.1:19999", owner=None)
+    waits = [f._backoff() for _ in range(12)]
+    lo, hi = 1 - _RETRY_JITTER, 1 + _RETRY_JITTER
+    assert _RETRY_S * lo <= waits[0] <= _RETRY_S * hi
+    for a, b in zip(waits, waits[1:6]):
+        assert b > a  # growth dominates jitter until the cap
+    for w in waits[8:]:
+        assert _RETRY_CAP_S * lo <= w <= _RETRY_CAP_S * hi
+    # seeded per address: a rebuilt follower replays the same waits
+    rebuilt = _Follower("127.0.0.1:19999", owner=None)
+    assert [rebuilt._backoff() for _ in range(12)] == waits
+    # an acked Replicate resets the schedule (what _stream does on
+    # progress — a peer that merely ACCEPTS connections but fails
+    # every Replicate keeps backing off)
+    f.connect_attempts = 0
+    assert f._backoff() <= _RETRY_S * hi
+
+
+def test_try_adopt_race_yields_exactly_one_owner():
+    """Two successor contexts racing the meta CAS for the same dead
+    owner's query: exactly one may win; the loser must journal an
+    adoption_lost event and stand down (return False). The barrier
+    holds both racers between their config read and their CAS write,
+    so both see the same base version — the true race interleaving."""
+    from hstream_tpu.server import scheduler
+    from hstream_tpu.server.context import ServerContext
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.versioned import VersionedConfigStore
+
+    store = open_store("mem://")
+    dead = ServerContext(store)
+    scheduler.record_assignment(dead, "q-race")  # the owner that died
+    a = ServerContext(store, persistence=dead.persistence)
+    b = ServerContext(store, persistence=dead.persistence)
+    assert dead.boot_epoch < a.boot_epoch < b.boot_epoch
+
+    barrier = threading.Barrier(2, timeout=10)
+    orig_put = VersionedConfigStore.put
+
+    def racing_put(self, *args, **kwargs):
+        barrier.wait()  # both racers read before either writes
+        return orig_put(self, *args, **kwargs)
+
+    results = {}
+
+    def race(name, ctx):
+        results[name] = scheduler.try_adopt(ctx, "q-race")
+
+    VersionedConfigStore.put = racing_put
+    try:
+        ta = threading.Thread(target=race, args=("a", a))
+        tb = threading.Thread(target=race, args=("b", b))
+        ta.start(); tb.start(); ta.join(10); tb.join(10)
+    finally:
+        VersionedConfigStore.put = orig_put
+    assert sorted(results.values()) == [False, True], results
+    winner, loser = (a, b) if results["a"] else (b, a)
+    # the winner's claim stands in the config store
+    owner = scheduler.assignment(winner, "q-race")
+    assert owner["epoch"] == winner.boot_epoch
+    # the loser journaled its stand-down for the operator timeline
+    lost = loser.events.query(kind="adoption_lost")
+    assert lost and lost[-1]["query"] == "q-race"
+    assert not winner.events.query(kind="adoption_lost")
+
+
+class _SupPersistence:
+    """Minimal persistence for QuerySupervisor unit tests: every query
+    reads back RUNNING (never terminated while pending)."""
+
+    def get_query(self, qid):
+        from hstream_tpu.server.persistence import QueryInfo, TaskStatus
+
+        return QueryInfo(qid, "select 1", 0, status=TaskStatus.RUNNING)
+
+    def set_query_status(self, qid, status):
+        pass
+
+
+class _SupCtx:
+    def __init__(self):
+        self.running_queries = {}
+        self.persistence = _SupPersistence()
+
+
+def test_supervisor_corpse_teardown_requeues_instead_of_dropping():
+    """note_death fires from the dying task's except block, but the
+    corpse pops running_queries LAST — its finally joins reader/persist
+    threads, which can outlast the ~0.2s first backoff. A restart
+    attempt that finds the dead task (``.error`` set) still registered
+    must requeue, not mistake the corpse for a live operator-owned task
+    and drop the restart forever; a task without ``.error`` really is
+    operator-owned and the restart stands down."""
+    from hstream_tpu.server.persistence import QueryInfo
+    from hstream_tpu.server.scheduler import QuerySupervisor
+
+    class _Corpse:
+        error = RuntimeError("died mid-batch")
+
+    class _OperatorTask:
+        error = None
+
+    ctx = _SupCtx()
+    clock = [100.0]
+    sup = QuerySupervisor(ctx, clock=lambda: clock[0])
+    resumed = []
+    sup.resume_fn = resumed.append
+    info = QueryInfo("q-corpse", "select 1", 0)
+
+    ctx.running_queries["q-corpse"] = _Corpse()
+    sup._attempt_restart("q-corpse", info, 1)
+    assert not resumed
+    assert "q-corpse" in sup.status()["pending"]  # requeued, not lost
+    # corpse finished tearing down: the requeued attempt lands
+    sup._pending.pop("q-corpse")  # what the loop does at dispatch
+    del ctx.running_queries["q-corpse"]
+    sup._attempt_restart("q-corpse", info, 1)
+    assert [i.query_id for i in resumed] == ["q-corpse"]
+    assert sup.restarts == 1
+    # a LIVE operator-started task (no .error) keeps ownership
+    ctx.running_queries["q-corpse"] = _OperatorTask()
+    sup._attempt_restart("q-corpse", info, 2)
+    assert len(resumed) == 1
+    assert "q-corpse" not in sup.status()["pending"]
+
+
+def test_supervisor_cancel_waits_out_inflight_restart():
+    """TerminateQuery racing an executing restart: the restart is
+    marked in-flight when it is popped from pending, and cancel()
+    blocks until it finishes — so the terminate path always runs AFTER
+    any resurrect and the task it pops from running_queries is the
+    final one (no deleted query springing back to RUNNING)."""
+    from hstream_tpu.server.persistence import QueryInfo
+    from hstream_tpu.server.scheduler import QuerySupervisor
+
+    release = threading.Event()
+    in_resume = threading.Event()
+
+    def resume(info):
+        in_resume.set()
+        assert release.wait(5)
+
+    sup = QuerySupervisor(_SupCtx(), resume_fn=resume)
+    sup.BACKOFF_BASE_S = 0.01
+    sup.BACKOFF_CAP_S = 0.05
+    try:
+        sup.note_death(QueryInfo("q-term", "select 1", 0))
+        assert in_resume.wait(5), sup.status()
+        cancel_done = threading.Event()
+
+        def terminate():
+            sup.cancel("q-term")
+            cancel_done.set()
+
+        t = threading.Thread(target=terminate)
+        t.start()
+        # the restart is still executing: cancel must not return yet
+        assert not cancel_done.wait(0.3)
+        release.set()
+        assert cancel_done.wait(5)  # returns once the resurrect landed
+        t.join(5)
+        assert sup.status()["pending"] == {}
+        assert sup.restarts == 1
+    finally:
+        release.set()
+        sup.shutdown()
+
+
+def test_query_labeled_counters_survive_live_stream_filter():
+    """/metrics liveness filter vs query-labeled counters: the
+    query_restarts / snapshot_fallbacks series are labeled by QUERY id,
+    which is never a live stream name — the filter silently dropped
+    them from the exposition (found by the PR 8 verify drive: a
+    supervised restart bumped the counter but /metrics showed no
+    series). They are exempt from the STREAM filter, like "_"-prefixed
+    pseudo-streams, but bounded by QUERY existence instead — a deleted
+    query's series must not grow the exposition forever."""
+    from hstream_tpu.stats import StatsHolder
+    from hstream_tpu.stats.prometheus import render_holder
+
+    stats = StatsHolder()
+    stats.stream_stat_add("query_restarts", "view-v1")
+    stats.stream_stat_add("snapshot_fallbacks", "view-v1")
+    stats.stream_stat_add("device_path_fallbacks", "src")   # stream-labeled
+    stats.stream_stat_add("device_path_fallbacks", "gone")  # deleted stream
+    text = render_holder(stats, live_streams={"src"})
+    assert 'hstream_query_restarts_total{stream="view-v1"} 1' in text
+    assert 'hstream_snapshot_fallbacks_total{stream="view-v1"} 1' in text
+    assert 'hstream_device_path_fallbacks_total{stream="src"} 1' in text
+    assert '"gone"' not in text  # liveness filter still applies
+    # the query-labeled exemption is bounded by query existence: a
+    # still-persisted (even FAILED) query keeps its series, a DELETED
+    # query's series are pruned from the scrape
+    text = render_holder(stats, live_streams={"src"},
+                         live_queries={"view-v1"})
+    assert 'hstream_query_restarts_total{stream="view-v1"} 1' in text
+    text = render_holder(stats, live_streams={"src"}, live_queries=set())
+    assert '"view-v1"' not in text

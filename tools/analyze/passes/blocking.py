@@ -24,6 +24,12 @@ Flagged inside a hot context (`blocking-hot`):
 
 Nested `def`s inside a hot function are skipped — they execute on
 other threads (callbacks, drain threads) with their own context.
+
+Carve-out (ISSUE 8): ``time.sleep`` inside methods of classes whose
+name ends in ``Supervisor`` is sanctioned — a supervisor's dedicated
+restart thread OWNS its latency budget; backoff sleeps between restart
+attempts are the mechanism, not a stall. Every other blocking call in
+a supervisor is still flagged.
 """
 
 from __future__ import annotations
@@ -81,33 +87,39 @@ def _thread_subclasses(files) -> set[tuple[str, str]]:
 
 
 def _hot_functions(src, thread_classes):
-    """Yield (fn, why) for every hot context in one file."""
+    """Yield (fn, why, allow_sleep) for every hot context in one
+    file; allow_sleep marks supervisor backoff threads (carve-out)."""
     if src.rel == _SCRAPE_FILE:
         for node in src.tree.body:
             if isinstance(node, ast.FunctionDef):
-                yield node, "prometheus scrape path"
+                yield node, "prometheus scrape path", False
     for cls in walk_classes(src.tree):
         servicer = cls.name.endswith(("Servicer", "Service"))
         threaded = (src.rel, cls.name) in thread_classes
+        # supervisor restart threads own their latency budget: backoff
+        # sleeps between restart attempts are sanctioned (ISSUE 8)
+        supervisor = cls.name.endswith("Supervisor")
         for node in cls.body:
             if not isinstance(node, ast.FunctionDef):
                 continue
             if servicer and node.name[:1].isupper():
-                yield node, f"gRPC handler {cls.name}.{node.name}"
+                yield node, f"gRPC handler {cls.name}.{node.name}", False
             elif threaded and node.name == "run":
-                yield node, f"worker loop {cls.name}.run"
+                yield node, f"worker loop {cls.name}.run", supervisor
             elif node.name.endswith("_loop"):
-                yield node, f"worker loop {cls.name}.{node.name}"
+                yield (node, f"worker loop {cls.name}.{node.name}",
+                       supervisor)
     for node in src.tree.body:
         if isinstance(node, ast.FunctionDef) \
                 and node.name.endswith("_loop") and src.rel != _SCRAPE_FILE:
-            yield node, f"worker loop {node.name}"
+            yield node, f"worker loop {node.name}", False
 
 
 class _BlockScan(ast.NodeVisitor):
-    def __init__(self, src, why: str):
+    def __init__(self, src, why: str, allow_sleep: bool = False):
         self.src = src
         self.why = why
+        self.allow_sleep = allow_sleep
         self.findings: list[Finding] = []
 
     def visit_FunctionDef(self, node):  # noqa: N802 — other threads
@@ -120,7 +132,9 @@ class _BlockScan(ast.NodeVisitor):
         name = call_name(node) or ""
         leaf = name.split(".")[-1]
         hit: str | None = None
-        if name in _HARD_BLOCK:
+        if name == "time.sleep" and self.allow_sleep:
+            hit = None  # supervisor backoff carve-out (ISSUE 8)
+        elif name in _HARD_BLOCK:
             hit = _HARD_BLOCK[name]
         elif name.startswith(_HARD_PREFIX):
             hit = name
@@ -146,11 +160,11 @@ def run(files, repo) -> list[Finding]:
     out: list[Finding] = []
     for src in files:
         seen: set[int] = set()
-        for fn, why in _hot_functions(src, thread_classes):
+        for fn, why, allow_sleep in _hot_functions(src, thread_classes):
             if id(fn) in seen:
                 continue
             seen.add(id(fn))
-            scan = _BlockScan(src, why)
+            scan = _BlockScan(src, why, allow_sleep)
             for stmt in fn.body:
                 scan.visit(stmt)
             out.extend(scan.findings)
