@@ -144,10 +144,34 @@ def cmd_snapshots(stub, args) -> list[dict]:
 def cmd_replicas(stub, args) -> list[dict]:
     out = _admin(stub, "replicas")
     if out and "followers" in out[0]:
-        fols = out[0]["followers"]
-        return ([{"role": out[0]["role"], **f} for f in fols]
-                or [{"role": out[0]["role"]}])
+        rows = []
+        leader = out[0].get("leader")
+        if leader:
+            # leadership state first (ISSUE 9): epoch, fencing, ack
+            # tuning, dedup footprint — sorted keys so operator diffs
+            # and test assertions are stable
+            rows.append({"role": "leader-status",
+                         **{k: leader[k] for k in sorted(leader)}})
+        fols = sorted(out[0]["followers"],
+                      key=lambda f: f.get("addr", ""))
+        rows.extend({"role": out[0]["role"], **f} for f in fols)
+        return rows or [{"role": out[0]["role"]}]
     return out
+
+
+def cmd_promote(stub, args) -> list[dict]:
+    """Epoch-fenced leader failover (ISSUE 9): planned handoff
+    (--target, through the current leader) or leader-death promotion
+    (--replicas, most-caught-up reachable replica wins)."""
+    kwargs = {}
+    if args.leader_addr:
+        kwargs["leader_addr"] = args.leader_addr
+    if args.target:
+        return _admin(stub, "promote", target=args.target, **kwargs)
+    if args.replicas:
+        return _admin(stub, "promote", replicas=args.replicas, **kwargs)
+    raise SystemExit("promote needs --target ADDR (planned handoff) "
+                     "or --replicas A,B,... (leader death)")
 
 
 def cmd_assignments(stub, args) -> list[dict]:
@@ -286,7 +310,21 @@ def main(argv=None) -> int:
     p = sub.add_parser("sub-lag", help="consumer lag of a subscription")
     p.add_argument("id")
     sub.add_parser("snapshots", help="per-query state snapshot sizes")
-    sub.add_parser("replicas", help="store replication follower status")
+    sub.add_parser("replicas", help="store replication follower status "
+                                    "+ leader epoch/fencing state")
+    p = sub.add_parser("promote",
+                       help="promote a store replica to leader "
+                            "(epoch-fenced failover)")
+    p.add_argument("--target", default=None, metavar="ADDR",
+                   help="planned handoff: the current leader promotes "
+                        "this follower and fences itself")
+    p.add_argument("--replicas", default=None, metavar="A,B,...",
+                   help="leader death: promote the most-caught-up "
+                        "reachable replica (highest (epoch, "
+                        "applied_seq, node_id) wins)")
+    p.add_argument("--leader-addr", default=None, metavar="ADDR",
+                   help="client-facing address served as the redirect "
+                        "hint (defaults to the promoted replica addr)")
     sub.add_parser("assignments", help="query -> server scheduler records")
     p = sub.add_parser("quota",
                        help="flow-control quotas: get/set/list/unset "

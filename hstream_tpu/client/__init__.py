@@ -70,12 +70,15 @@ class Client:
 
     def __init__(self, addr: str, out=None,
                  retry: RetryPolicy | None = None):
+        self.addr = addr
         self.channel = grpc.insecure_channel(addr)
         self.stub = HStreamApiStub(self.channel)
         self.out = out or sys.stdout
         # RESOURCE_EXHAUSTED (quota/overload shed) retries with jittered
-        # backoff honoring the server's retry-after hint; every other
-        # status surfaces immediately
+        # backoff honoring the server's retry-after hint; a NOT_LEADER
+        # refusal (UNAVAILABLE + leader hint after a store failover)
+        # rebinds the channel to the hinted leader and retries; every
+        # other status surfaces immediately
         self.retry = retry or RetryPolicy()
         # correlation: every statement gets a fresh request id, stamped
         # into the gRPC metadata; kept here so "what id did my last
@@ -97,9 +100,30 @@ class Client:
     def _metadata(self) -> tuple:
         return ((REQUEST_ID_KEY, self._new_request_id()),)
 
-    def _call(self, method, request):
-        return self.retry.call(method, request,
-                               metadata=self._metadata())
+    def _follow_leader_hint(self, hint: str) -> None:
+        """The server lost store leadership: reconnect to the hinted
+        new leader so the retry (and every later statement) lands
+        there (ISSUE 9 failover-aware clients)."""
+        print(f"-- leader moved; following hint to {hint} --",
+              file=self.out)
+        old = self.channel
+        self.addr = hint
+        self.channel = grpc.insecure_channel(hint)
+        self.stub = HStreamApiStub(self.channel)
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 — the old channel is dead
+            pass           # weight either way
+
+    def _call(self, method: str, request):
+        # resolve the RPC by NAME each attempt: a leader-hint follow
+        # swaps self.stub, and a bound method would pin the old channel
+        def attempt(req, **kw):
+            return getattr(self.stub, method)(req, **kw)
+
+        return self.retry.call(attempt, request,
+                               metadata=self._metadata(),
+                               on_leader_hint=self._follow_leader_hint)
 
     # ---- statement routing (client.hs:91-132) ---------------------------
 
@@ -113,15 +137,15 @@ class Client:
             if isinstance(plan, plans.SelectPlan) and plan.emit_changes:
                 self._push_query(sql)
             elif isinstance(plan, plans.CreateViewPlan):
-                v = self._call(self.stub.CreateView,
+                v = self._call("CreateView",
                                pb.CreateViewRequest(sql=sql))
                 print(f"view {v.view_id} created", file=self.out)
             elif isinstance(plan, plans.CreateSinkConnectorPlan):
-                c = self._call(self.stub.CreateSinkConnector,
+                c = self._call("CreateSinkConnector",
                                pb.CreateSinkConnectorRequest(config=sql))
                 print(f"connector {c.id} created", file=self.out)
             elif isinstance(plan, plans.CreatePlan):
-                self._call(self.stub.CreateStream, pb.Stream(
+                self._call("CreateStream", pb.Stream(
                     stream_name=plan.stream, replication_factor=1))
                 print(f"stream {plan.stream} created", file=self.out)
             elif isinstance(plan, plans.TerminatePlan):
@@ -129,11 +153,11 @@ class Client:
                        if plan.query_id is None else
                        pb.TerminateQueriesRequest(
                            query_ids=[plan.query_id]))
-                done = self._call(self.stub.TerminateQueries, req)
+                done = self._call("TerminateQueries", req)
                 print(f"terminated: {list(done.query_ids)}",
                       file=self.out)
             else:
-                resp = self._call(self.stub.ExecuteQuery,
+                resp = self._call("ExecuteQuery",
                                   pb.CommandQuery(stmt_text=sql))
                 rows = [rec.struct_to_dict(s) for s in resp.result_set]
                 print(format_table(rows), file=self.out)

@@ -15,6 +15,17 @@ mid-call transport drops, is explicitly NON_RETRYABLE), sleeping
 
 for at most `attempts` tries. Sleep/rng are injectable so tier-1 tests
 drive convergence with a fake clock and zero wall-clock sleeps.
+
+Leader failover (ISSUE 9): a fenced store leader refuses mutations
+with UNAVAILABLE carrying the NEW leader's address twice — an
+``x-leader-hint`` trailing-metadata entry and a ``not_leader
+leader_hint=ADDR`` token in the message. UNAVAILABLE stays
+non-retryable in general (a mid-call transport drop may have landed a
+mutation), but WITH a hint the refusal was issued before any work, so
+`RetryPolicy.call` follows it: the caller passes ``on_leader_hint``
+(rebind your channel/stub to the hinted address) and the policy
+retries with the same jittered backoff instead of failing the
+statement (`HINTED_RETRYABLE_CODES`).
 """
 
 from __future__ import annotations
@@ -27,6 +38,8 @@ import grpc
 
 RETRY_AFTER_KEY = "retry-after-ms"
 _RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d+)")
+LEADER_HINT_KEY = "x-leader-hint"
+_LEADER_HINT_RE = re.compile(r"not_leader leader_hint=([^\s)]+)")
 
 # Retryability classification of every status the server emits (the
 # analyzer's errcontract pass keeps this table honest in both
@@ -62,6 +75,15 @@ NON_RETRYABLE_CODES = frozenset({
     grpc.StatusCode.ABORTED,
     grpc.StatusCode.UNAVAILABLE,
 })
+# Statuses retryable ONLY when the error carries a leader hint (the
+# NOT_LEADER contract): the refusal is issued before any work, and the
+# hint names where to send the retry. The BARE form of each code stays
+# in NON_RETRYABLE_CODES — without the hint an UNAVAILABLE may be a
+# mid-call transport drop whose mutation landed. The errcontract pass
+# enforces both halves (hinted ⊆ non-retryable-bare, hinted ⊆ emitted).
+HINTED_RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+})
 
 
 def is_retryable(code) -> bool:
@@ -90,6 +112,24 @@ def retry_after_ms_from_error(e: grpc.RpcError) -> int | None:
     return int(m.group(1)) if m else None
 
 
+def leader_hint_from_error(e: grpc.RpcError) -> str | None:
+    """The new leader's address from a NOT_LEADER refusal, or None:
+    trailing metadata first, message token as the fallback."""
+    try:
+        md = e.trailing_metadata() or ()
+    except Exception:  # noqa: BLE001 — not all RpcErrors carry it
+        md = ()
+    for k, v in md:
+        if k == LEADER_HINT_KEY and v:
+            return str(v)
+    try:
+        details = e.details() or ""
+    except Exception:  # noqa: BLE001
+        details = str(e)
+    m = _LEADER_HINT_RE.search(details)
+    return m.group(1) if m else None
+
+
 class RetryPolicy:
     """Bounded retry of retryable statuses with jittered backoff."""
 
@@ -101,6 +141,7 @@ class RetryPolicy:
         self._sleep = time.sleep if sleep is None else sleep
         self._rng = random.Random() if rng is None else rng
         self.retries = 0  # total retries performed over this policy
+        self.leader_follows = 0  # retries that followed a leader hint
 
     def next_delay_ms(self, attempt: int,
                       hint_ms: int | None = None) -> float:
@@ -109,7 +150,13 @@ class RetryPolicy:
         cap = min(self.max_ms, self.base_ms * (1 << attempt))
         return max(1.0, cap * self._rng.random())
 
-    def call(self, fn, *args, **kwargs):
+    def call(self, fn, *args, on_leader_hint=None, **kwargs):
+        """Call `fn`, retrying retryable statuses. `on_leader_hint`
+        (optional) makes a NOT_LEADER refusal — a HINTED_RETRYABLE
+        status carrying a leader hint — followable: the callback
+        receives the hinted address (rebind your channel/stub there)
+        and the call retries with the same jittered backoff. Without
+        the callback, hinted errors surface like any non-retryable."""
         for attempt in range(self.attempts):
             try:
                 return fn(*args, **kwargs)
@@ -119,9 +166,17 @@ class RetryPolicy:
                     code = e.code()
                 except Exception:  # noqa: BLE001
                     pass
-                if not is_retryable(code) or attempt == self.attempts - 1:
+                hint = None
+                if (on_leader_hint is not None
+                        and code in HINTED_RETRYABLE_CODES):
+                    hint = leader_hint_from_error(e)
+                if ((not is_retryable(code) and hint is None)
+                        or attempt == self.attempts - 1):
                     raise
                 self.retries += 1
+                if hint is not None:
+                    self.leader_follows += 1
+                    on_leader_hint(hint)
                 delay = self.next_delay_ms(
                     attempt, retry_after_ms_from_error(e))
                 self._sleep(delay / 1000.0)

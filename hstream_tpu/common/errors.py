@@ -46,6 +46,44 @@ class StoreIOError(StoreError):
     pass
 
 
+class ReplicaDivergence(StoreIOError):
+    """A replica's local store no longer matches the op-log it is
+    asked to apply (an append would land at the wrong LSN). The
+    replica halts loudly and refuses further entries — an operator
+    re-bootstraps it from a copy of a live store; drifting quietly is
+    never an option."""
+
+
+class NotLeaderError(StoreError):
+    """This node no longer leads the replicated store (fenced by a
+    higher epoch). The NOT_LEADER contract: rides UNAVAILABLE — the
+    one status that means "not here, maybe elsewhere" — with the new
+    leader's address attached twice, as an ``x-leader-hint``
+    trailing-metadata entry at the gRPC boundary and a
+    ``not_leader leader_hint=ADDR`` token in the message text.
+    Clients follow the hint with jittered backoff
+    (client/retry.HINTED_RETRYABLE_CODES) instead of failing the
+    statement; a bare UNAVAILABLE (mid-call transport drop, no hint)
+    stays non-retryable at that layer."""
+
+    grpc_status = grpc.StatusCode.UNAVAILABLE
+
+    def __init__(self, message: str = "",
+                 leader_hint: str | None = None):
+        if leader_hint:
+            message = f"{message} (not_leader leader_hint={leader_hint})"
+        super().__init__(message)
+        self.leader_hint = leader_hint
+
+
+class DuplicateAppend(StoreError):
+    """A producer-stamped append whose seq fell behind the bounded
+    dedup window: the original may already be stored, so re-appending
+    could duplicate — refused loudly instead."""
+
+    grpc_status = grpc.StatusCode.ALREADY_EXISTS
+
+
 # ---- SQL -------------------------------------------------------------------
 
 class SQLError(HStreamError):

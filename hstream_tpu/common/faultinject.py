@@ -52,6 +52,12 @@ Instrumented sites (the registry accepts any name; these exist today):
     store.oplog.apply       replica op application (leader + follower)
     store.follower.connect  leader-side sender (re)connect to a follower
     store.follower.ack      leader-side Replicate RPC entry
+    replica.heartbeat.drop  leader-side idle heartbeat (lease expiry)
+    replica.partition       follower-side Replicate entry: the follower
+                            is unreachable from its leader (the RPC
+                            fails before the epoch/bind checks)
+    replica.promote.race    follower-side Promote entry (widens the
+                            dueling-promotion race window)
     snapshot.persist        operator-state blob write (mutate: torn)
     snapshot.restore        operator-state blob read at task start
     checkpoint.flush        checkpoint store write (mutate: torn)

@@ -85,19 +85,67 @@ class _CorrelatedStub:
 
 
 class Gateway:
-    """Routes HTTP requests onto a single shared gRPC stub."""
+    """Routes HTTP requests onto a single shared gRPC stub.
+
+    Failover-aware (ISSUE 9): when the server answers UNAVAILABLE with
+    a leader hint (its replicated store was fenced by a promoted
+    follower), the gateway rebinds its channel to the hinted leader
+    and retries the request once instead of bouncing a 503 to every
+    HTTP caller; later requests ride the rebound channel."""
 
     def __init__(self, server_addr: str):
+        self.server_addr = server_addr
+        self.leader_follows = 0  # rebinds performed after a hint
+        self._bind_lock = threading.Lock()
         self.channel = grpc.insecure_channel(server_addr)
         self.stub = _CorrelatedStub(HStreamApiStub(self.channel))
+        # channels replaced by a leader-hint rebind, closed only at
+        # gateway shutdown: another handler thread may still have an
+        # RPC in flight on the old channel (e.g. a read the fenced
+        # leader can still serve) — closing it mid-call would turn
+        # that request into a spurious CANCELLED/500. Bounded by the
+        # number of failovers over the gateway's lifetime.
+        self._retired: list = []
 
     def close(self) -> None:
+        with self._bind_lock:
+            retired, self._retired = self._retired, []
+        for ch in retired:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
         self.channel.close()
+
+    def _follow_leader_hint(self, hint: str) -> None:
+        """Rebind the shared channel/stub to the hinted new leader.
+        Concurrent requests that all hit the fenced leader rebind
+        once — the second caller finds the address already current."""
+        with self._bind_lock:
+            if hint == self.server_addr:
+                return
+            self._retired.append(self.channel)
+            self.server_addr = hint
+            self.channel = grpc.insecure_channel(hint)
+            self.stub = _CorrelatedStub(HStreamApiStub(self.channel))
+            self.leader_follows += 1
 
     # ---- resource handlers -----------------------------------------------
 
     def handle(self, method: str, path: str, body: dict | None,
                query: str = "") -> tuple[int, Any]:
+        out = self._handle_once(method, path, body, query)
+        hint = out[2].pop("x-follow-leader", None) if len(out) > 2 else None
+        if hint is not None:
+            # NOT_LEADER: follow the hint and retry this request once
+            self._follow_leader_hint(hint)
+            out = self._handle_once(method, path, body, query)
+            if len(out) > 2:
+                out[2].pop("x-follow-leader", None)
+        return out
+
+    def _handle_once(self, method: str, path: str, body: dict | None,
+                     query: str = "") -> tuple[int, Any]:
         stub = self.stub
         try:
             if path == "/overview" and method == "GET":
@@ -222,6 +270,16 @@ class Gateway:
             return 404, {"error": f"no route {method} {path}"}
         except grpc.RpcError as e:
             code = _STATUS.get(e.code(), 500)
+            if e.code() == grpc.StatusCode.UNAVAILABLE:
+                from hstream_tpu.client.retry import leader_hint_from_error
+
+                hint = leader_hint_from_error(e)
+                if hint:
+                    # signal handle() to rebind + retry; if the retry
+                    # fails too, the body still names the new leader
+                    return (code, {"error": e.details() or "not leader",
+                                   "leader_hint": hint},
+                            {"x-follow-leader": hint})
             if code == 429:
                 # flow-control refusal: surface the server's retry-after
                 # hint as the standard header (seconds, rounded up)

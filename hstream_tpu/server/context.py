@@ -88,11 +88,18 @@ class ServerContext:
         # store, since when" on the serving node
         if hasattr(store, "follower_status"):
             store.journal = self.events
+            # fenced_appends / promotions counters + the epoch gauge
+            # sample through this binding (stats/prometheus.py)
+            store.stats = self.stats
             self.events.append(
                 "leader_change",
                 f"this server leads the replicated store as "
-                f"{store.node_id}",
-                leader=store.node_id)
+                f"{store.node_id} (epoch {store.epoch})",
+                leader=store.node_id, epoch=store.epoch)
+        # producer-stamped appends on a NON-replicated store serialize
+        # their lookup+append+record through this lock (the replicated
+        # store has its own critical section; store/dedup.py)
+        self.dedup_lock = threading.Lock()
         # CAS-versioned cluster config (reference VersionedConfigStore);
         # first consumer: the boot-epoch counter below — each server
         # boot on a store CAS-increments it, so concurrent servers on

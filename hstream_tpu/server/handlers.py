@@ -25,6 +25,7 @@ from hstream_tpu.common.errors import (
     HStreamError,
     QueryNotFound,
     ServerError,
+    SQLValidateError,
     StreamNotFound,
 )
 from hstream_tpu.common.idgen import gen_unique
@@ -73,12 +74,18 @@ VIRTUAL_TABLES = frozenset({
 
 def _abort_hstream(context, e: HStreamError) -> None:
     """Map a typed error to its gRPC status; flow-control refusals also
-    carry the retry-after hint as trailing metadata so clients can back
-    off without parsing the message text."""
+    carry the retry-after hint, and NOT_LEADER refusals the new
+    leader's address, as trailing metadata so clients can back off /
+    follow without parsing the message text."""
+    md = []
     ra = getattr(e, "retry_after_ms", None)
     if ra is not None:
-        context.set_trailing_metadata(
-            (("retry-after-ms", str(int(ra))),))
+        md.append(("retry-after-ms", str(int(ra))))
+    hint = getattr(e, "leader_hint", None)
+    if hint:
+        md.append(("x-leader-hint", str(hint)))
+    if md:
+        context.set_trailing_metadata(tuple(md))
     context.abort(e.grpc_status, str(e) or type(e).__name__)
 
 
@@ -100,6 +107,51 @@ def _request_id_from(context) -> str:
     except Exception:  # noqa: BLE001 — metadata is best-effort
         pass
     return ""
+
+
+def _producer_from(context) -> tuple[str, int] | None:
+    """SQL INSERT idempotence stamp: Append carries the producer on the
+    request proto; ExecuteQuery carries it as `x-producer-id` /
+    `x-producer-seq` metadata (the statement text stays portable). A
+    malformed seq on a stamped request is refused INVALID_ARGUMENT —
+    silently running the INSERT unstamped would break the exactly-once
+    contract the client thinks it has (its retry would double-append)."""
+    pid, seq, bad = "", None, None
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == "x-producer-id":
+                pid = str(v)
+            elif k == "x-producer-seq":
+                try:
+                    seq = int(v)
+                except ValueError:
+                    bad = str(v)
+    except Exception:  # noqa: BLE001 — metadata is best-effort
+        return None
+    if pid and bad is not None:
+        raise SQLValidateError(
+            f"malformed x-producer-seq {bad!r} on a stamped request "
+            f"(producer {pid!r}): must be a base-10 integer")
+    return (pid, seq) if pid and seq is not None else None
+
+
+def _dedup_append(ctx, logid: int, payloads, compression,
+                  producer_id: str, producer_seq: int
+                  ) -> tuple[int, int, bool]:
+    """Producer-stamped append against either store shape: the
+    replicated store runs the lookup+log+apply in ONE critical section
+    (and the stamp rides the op-log so every replica derives the same
+    window); a single-node store gets the same atomicity from the
+    context-level dedup lock. Returns (lsn, n_records, was_dup)."""
+    store = ctx.store
+    if hasattr(store, "append_batch_dedup"):
+        return store.append_batch_dedup(
+            logid, payloads, compression,
+            producer_id=producer_id, producer_seq=producer_seq)
+    from hstream_tpu.store import dedup
+
+    return dedup.guarded_append(store, ctx.dedup_lock, logid, payloads,
+                                compression, producer_id, producer_seq)
 
 
 def _rpc_hist_label(rpc: str, request) -> str:
@@ -247,19 +299,35 @@ class HStreamApiServicer:
         if ctx.flow.active:
             ctx.flow.admit_append(request.stream_name, len(payloads),
                                   nbytes)
+        compression = getattr(ctx, "append_compression", Compression.NONE)
         try:
-            lsn = ctx.store.append_batch(
-                logid, payloads,
-                getattr(ctx, "append_compression", Compression.NONE))
+            if request.producer_id:
+                # idempotent append (ISSUE 9): the (producer_id, seq)
+                # stamp rides the replicated entry, so a retry — even
+                # one that straddles a leader failover — is answered
+                # with the ORIGINAL record ids on every replica
+                lsn, n, dup = _dedup_append(
+                    ctx, logid, payloads, compression,
+                    request.producer_id, request.producer_seq)
+            else:
+                lsn, n, dup = ctx.store.append_batch(
+                    logid, payloads, compression), len(payloads), False
         except Exception:
-            # admitted but not stored (store I/O, replication broken):
-            # the failure counter separates this from quota refusals
+            # admitted but not stored (store I/O, replication broken,
+            # seq behind the dedup window): the failure counter
+            # separates this from quota refusals
             ctx.stats.stream_stat_add("append_failed",
                                       request.stream_name)
             raise
-        ctx.stats.note_append(request.stream_name, len(payloads), nbytes)
-        out = pb.AppendResponse(stream_name=request.stream_name)
-        for i in range(len(payloads)):
+        if dup:
+            ctx.stats.stream_stat_add("append_deduped",
+                                      request.stream_name)
+        else:
+            ctx.stats.note_append(request.stream_name, len(payloads),
+                                  nbytes)
+        out = pb.AppendResponse(stream_name=request.stream_name,
+                                duplicate=dup)
+        for i in range(n):
             out.record_ids.append(pb.RecordId(batch_id=lsn, batch_index=i))
         return out
 
@@ -348,7 +416,8 @@ class HStreamApiServicer:
     @unary
     def ExecuteQuery(self, request, context):
         plan = stream_codegen(request.stmt_text)
-        rows = self._execute_plan(plan, request.stmt_text)
+        rows = self._execute_plan(plan, request.stmt_text,
+                                  producer=_producer_from(context))
         out = pb.CommandQueryResponse()
         for row in rows:
             out.result_set.append(_struct(row))
@@ -776,6 +845,42 @@ class HStreamApiServicer:
             status = getattr(ctx.store, "follower_status", None)
             out = {"role": "leader" if status else "single",
                    "followers": status() if status else []}
+            leader = getattr(ctx.store, "leader_status", None)
+            if leader is not None:
+                # epoch/fencing/dedup state (ISSUE 9): one verb answers
+                # "who leads, at what epoch, is anyone fenced"
+                out["leader"] = leader()
+        elif cmd == "promote":
+            # epoch-fenced failover (ISSUE 9). Two shapes:
+            #   promote target=ADDR        planned handoff — THIS
+            #     leader raises the target's epoch and fences itself
+            #   promote replicas=A,B,...   leader-death path — pick the
+            #     most-caught-up reachable replica (highest
+            #     (epoch, applied_seq, node_id)) and promote it
+            from hstream_tpu.store import replica as _replica
+
+            target = args.get("target") or None
+            addrs = [a.strip()
+                     for a in str(args.get("replicas") or "").split(",")
+                     if a.strip()]
+            hint = args.get("leader_addr") or None
+            if target:
+                promote = getattr(ctx.store, "promote_follower", None)
+                if promote is None:
+                    raise ServerError(
+                        "this server's store is not a replication "
+                        "leader; use promote replicas=A,B,... against "
+                        "the replica group directly")
+                out = promote(target, leader_addr=hint)
+            elif addrs:
+                out = _replica.promote_best(
+                    addrs, leader_addr=hint,
+                    promoted_by=scheduler.node_name(ctx))
+            else:
+                raise ServerError(
+                    "promote needs target=ADDR or replicas=A,B,...")
+            if out.get("ok"):
+                ctx.stats.stream_stat_add("promotions", "_store")
         elif cmd == "assignments":
             out = scheduler.assignments(ctx)
         elif cmd == "quota-set":
@@ -872,7 +977,9 @@ class HStreamApiServicer:
 
     # ---- plan execution (executeQueryHandler dispatch) ----------------------
 
-    def _execute_plan(self, plan, sql: str) -> list[dict[str, Any]]:
+    def _execute_plan(self, plan, sql: str,
+                      producer: tuple[str, int] | None = None
+                      ) -> list[dict[str, Any]]:
         ctx = self.ctx
         if isinstance(plan, plans.CreatePlan):
             _reject_virtual_name("stream", plan.stream)
@@ -900,10 +1007,21 @@ class HStreamApiServicer:
             if ctx.flow.active:  # SQL INSERT is an ingress path too
                 ctx.flow.admit_append(plan.stream, 1, len(data))
             try:
-                lsn = ctx.store.append(logid, data)
+                if producer is not None:
+                    # stamped INSERT: same exactly-once contract as a
+                    # stamped Append (retry across failover dedups)
+                    lsn, _n, dup = _dedup_append(
+                        ctx, logid, [data], Compression.NONE,
+                        producer[0], producer[1])
+                else:
+                    lsn, dup = ctx.store.append(logid, data), False
             except Exception:
                 ctx.stats.stream_stat_add("append_failed", plan.stream)
                 raise
+            if dup:
+                ctx.stats.stream_stat_add("append_deduped", plan.stream)
+                return [{"stream": plan.stream, "lsn": lsn,
+                         "duplicate": True}]
             ctx.stats.note_append(plan.stream, 1, len(data))
             return [{"stream": plan.stream, "lsn": lsn}]
         if isinstance(plan, plans.ShowPlan):

@@ -43,6 +43,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           snapshot_interval_ms: int | None = None,
           replicate: str | None = None,
           replication_factor: int = 2,
+          replica_ack_timeout_ms: int | None = None,
+          store: "LogStore | None" = None,
           append_compression: str | None = None,
           pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
           encode_workers: int = DEFAULT_ENCODE_WORKERS,
@@ -57,15 +59,24 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     over a (data, key) device mesh (SURVEY §2.3). `replicate` (comma-
     separated follower replica addresses) makes this server the store
     LEADER: every store mutation replicates to those follower nodes
-    (run with ``python -m hstream_tpu.store.replica``) over DCN."""
-    store = open_store(store_uri, sync_interval_ms=sync_interval_ms,
-                       segment_bytes=segment_bytes)
+    (run with ``python -m hstream_tpu.store.replica``) over DCN.
+    `replica_ack_timeout_ms` bounds the follower-ack wait per append
+    (expiry journals `replica_ack_timeout` and degrades honestly).
+    `store` (an already-open LogStore) overrides `store_uri` — the
+    failover path: promote a follower, then boot a server OVER its
+    (promoted) store; the epoch persisted in store meta carries the
+    leadership forward."""
+    if store is None:
+        store = open_store(store_uri, sync_interval_ms=sync_interval_ms,
+                           segment_bytes=segment_bytes)
     if replicate:
         from hstream_tpu.store.replica import ReplicatedStore
 
         store = ReplicatedStore(
             store, [a.strip() for a in replicate.split(",") if a.strip()],
-            replication_factor=replication_factor)
+            replication_factor=replication_factor,
+            ack_timeout_s=(replica_ack_timeout_ms / 1000.0
+                           if replica_ack_timeout_ms else None))
     mesh = _build_mesh(mesh_shape) if mesh_shape else None
     ctx = ServerContext(store, host=host, port=port, mesh=mesh,
                         pipeline_depth=pipeline_depth,
@@ -96,6 +107,12 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     if bound == 0:
         raise RuntimeError(f"cannot bind {host}:{port}")
     ctx.port = bound
+    if hasattr(ctx.store, "client_addr"):
+        # the address that rides every Replicate as the leader hint:
+        # followers persist it and serve it to redirected clients, so
+        # it must be THIS server's client-facing endpoint (known only
+        # after the bind)
+        ctx.store.client_addr = f"{host}:{bound}"
     # only after a successful bind: a failed boot (port in use) must not
     # relaunch tasks and re-emit at-least-once rows before dying
     servicer.resume_persisted()
@@ -144,6 +161,11 @@ def _parse_args(argv):
                          "--replicate-factor onto LogDevice)")
     ap.add_argument("--replication-factor", type=int, default=None,
                     help="copies (incl. leader) an append waits for")
+    ap.add_argument("--replica-ack-timeout-ms", type=int, default=None,
+                    help="follower-ack deadline per append; expiry "
+                         "journals replica_ack_timeout and records a "
+                         "degraded ack instead of blocking forever "
+                         "(default 5000)")
     ap.add_argument("--append-compression", default=None,
                     choices=["none", "zlib"],
                     help="storage compression for appended batches "
@@ -179,7 +201,9 @@ def _parse_args(argv):
                 "workers": 32, "mesh": None, "log_level": None,
                 "sync_interval_ms": None, "segment_bytes": None,
                 "snapshot_interval_ms": None, "replicate": None,
-                "replication_factor": 2, "append_compression": None,
+                "replication_factor": 2,
+                "replica_ack_timeout_ms": None,
+                "append_compression": None,
                 "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
                 "encode_workers": DEFAULT_ENCODE_WORKERS,
                 "credit_window": None,
@@ -220,6 +244,7 @@ def main(argv=None) -> None:
         snapshot_interval_ms=cfg["snapshot_interval_ms"],
         replicate=cfg["replicate"],
         replication_factor=cfg["replication_factor"],
+        replica_ack_timeout_ms=cfg["replica_ack_timeout_ms"],
         append_compression=cfg["append_compression"],
         pipeline_depth=cfg["pipeline_depth"],
         encode_workers=cfg["encode_workers"],

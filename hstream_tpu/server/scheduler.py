@@ -252,6 +252,29 @@ class QuerySupervisor:
         when a supervised query died unexpectedly. Schedules a restart
         or opens the crash-loop breaker."""
         qid = info.query_id
+        from hstream_tpu.common.errors import NotLeaderError
+
+        if isinstance(error, NotLeaderError):
+            # leadership loss is NOT a crash loop (ISSUE 9): this
+            # node's store was fenced by a promoted peer, so every
+            # restart would die the same way and burn the breaker.
+            # Stand down instead — the status write on the fenced
+            # store failed, so the replicated record still says
+            # RUNNING, and the NEW leader's boot (higher boot epoch
+            # over the promoted replica) adopts the query through the
+            # normal resume path.
+            log.warning(
+                "query %s died of leadership loss (%s); standing down "
+                "instead of restarting — the promoted leader adopts it",
+                qid, error)
+            self._journal(
+                "replica_fenced",
+                f"query {qid} stopped: store leadership lost "
+                f"({error}); awaiting adoption by the new leader",
+                query=qid, leader_hint=error.leader_hint)
+            with self._lock:
+                self._forget_locked(qid)
+            return
         now = self.clock()
         with self._lock:
             if self._stopped or qid in self._breaker_open:
@@ -357,12 +380,15 @@ class QuerySupervisor:
     def status(self) -> dict:
         with self._lock:
             now = self.clock()
+            # pending sorted by query id (ISSUE 9 satellite): admin
+            # output and chaos-test assertions must not depend on
+            # dict-insertion order
             return {
                 "restarts": self.restarts,
                 "pending": {qid: {"due_in_s": round(due - now, 3),
                                   "attempt": attempt}
                             for qid, (due, _i, attempt)
-                            in self._pending.items()},
+                            in sorted(self._pending.items())},
                 "breaker_open": sorted(self._breaker_open),
             }
 

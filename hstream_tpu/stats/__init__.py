@@ -47,6 +47,13 @@ PER_STREAM_COUNTERS = [
     "device_path_fallbacks",   # device-join / fused-close activations
                                # that degraded to the host reference
                                # path (label: source stream)
+    "promotions",              # replica promotions driven through this
+                               # server (label: "_store")
+    "fenced_appends",          # mutations refused NOT_LEADER after the
+                               # store was fenced (label: "_store")
+    "append_deduped",          # producer-stamped appends answered from
+                               # the dedup window (retry landed exactly
+                               # once; label: stream)
 ]
 
 PER_STREAM_TIME_SERIES = [
@@ -75,6 +82,10 @@ GAUGES = [
     "event_journal_size",     # entries currently held by the journal
     "crash_loop_open",        # per query: 1 while the supervisor's
                               # crash-loop breaker holds it FAILED
+    "replica_epoch",          # leadership epoch of the replicated
+                              # store this server fronts
+    "dedup_window_size",      # producer-dedup seqs remembered across
+                              # all producers (bounded per producer)
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);

@@ -38,6 +38,11 @@ EVENT_KINDS = [
     "checkpoint_corrupt",  # checkpoint store recovered from bad bytes
     "fault_injected",    # a chaos fault site fired
     "adoption_lost",     # lost the CAS race adopting a query
+    "replica_fenced",    # a stale leader was rejected by epoch (or
+                         # THIS leader learned it was fenced)
+    "replica_promoted",  # a replica was raised to leadership
+    "replica_ack_timeout",  # a follower-ack deadline expired; the
+                            # append degraded honestly
 ]
 
 

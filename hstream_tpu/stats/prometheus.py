@@ -62,6 +62,11 @@ _HELP = {
                           "slot for the previous good one",
     "device_path_fallbacks": "device kernel activations degraded to "
                              "the host reference path",
+    "promotions": "replica promotions driven through this server",
+    "fenced_appends": "mutations refused NOT_LEADER after the store "
+                      "was fenced by a higher epoch",
+    "append_deduped": "producer-stamped appends answered from the "
+                      "dedup window (retries landed exactly once)",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",
@@ -77,6 +82,10 @@ _HELP = {
     "event_journal_size": "entries held by the event journal",
     "crash_loop_open": "1 while the crash-loop breaker holds a query "
                        "FAILED",
+    "replica_epoch": "leadership epoch of the replicated store this "
+                     "server fronts",
+    "dedup_window_size": "producer-dedup seqs remembered across all "
+                         "producers",
     "append_latency_ms": "Append RPC latency",
     "fetch_latency_ms": "Fetch RPC latency",
     "sql_execute_latency_ms": "ExecuteQuery RPC latency",
@@ -290,6 +299,17 @@ def sample_gauges(ctx) -> None:
         except Exception:  # noqa: BLE001
             pass
     _drop_stale(stats, ("replica_ack_lag",), live_f)
+    # leadership epoch + producer-dedup footprint (ISSUE 9): sampled
+    # from the leader store's status so a scrape answers "what epoch
+    # does this node serve at" without an admin round trip
+    leader_status = getattr(ctx.store, "leader_status", None)
+    if leader_status is not None:
+        try:
+            ls = leader_status()
+            stats.gauge_set("replica_epoch", "", ls["epoch"])
+            stats.gauge_set("dedup_window_size", "", ls["dedup_window"])
+        except Exception:  # noqa: BLE001 — a closing store must not
+            pass           # fail the scrape
     # durable store footprint (native store roots at a directory)
     root = getattr(ctx.store, "root", None) \
         or getattr(getattr(ctx.store, "local", None), "root", None)

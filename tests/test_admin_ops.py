@@ -258,3 +258,91 @@ def test_append_compression_knob():
         ch.close()
         server.stop(grace=1)
         ctx.shutdown()
+
+
+def test_admin_promote_verb_and_replicas_leader_status():
+    """ISSUE 9 operator surface: `admin replicas` reports the leader's
+    epoch/fencing/dedup state, `admin promote target=` runs the
+    planned handoff (promote + self-fence + seal), the promotions
+    counter ticks, and the fenced server refuses further appends with
+    the NOT_LEADER hint."""
+    import socket
+
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import serve_follower
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    fport = s.getsockname()[1]
+    s.close()
+    f_store = open_store("mem://")
+    fsrv, svc = serve_follower(f_store, f"127.0.0.1:{fport}",
+                               node_id="adm-f")
+    server, ctx = serve("127.0.0.1", 0, "mem://",
+                        replicate=f"127.0.0.1:{fport}",
+                        replication_factor=2,
+                        replica_ack_timeout_ms=2500)
+    channel = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(channel)
+    try:
+        stub.CreateStream(pb.Stream(stream_name="adm"))
+        append_rows(stub, "adm", [{"i": 1}], [BASE])
+
+        out = admin(stub, "replicas")
+        assert out["role"] == "leader"
+        lead = out["leader"]
+        assert lead["epoch"] == 0 and lead["fenced"] is False
+        assert lead["ack_timeout_ms"] == 2500  # the threaded flag
+        assert lead["dedup_window"] == 0
+
+        res = admin(stub, "promote", target=f"127.0.0.1:{fport}",
+                    leader_addr="next:1")
+        assert res["ok"] and res["epoch"] == 1
+        assert res["node_id"] == "adm-f"
+        assert svc.is_leader and svc.epoch == 1
+        assert ctx.stats.stream_stat_get("promotions", "_store") == 1
+
+        out = admin(stub, "replicas")
+        assert out["leader"]["fenced"] is True
+        assert out["leader"]["fenced_by_epoch"] == 1
+        assert out["leader"]["leader_hint"] == "next:1"
+
+        try:
+            append_rows(stub, "adm", [{"i": 2}], [BASE + 1])
+            raise AssertionError("fenced server accepted an append")
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.UNAVAILABLE
+            assert "not_leader leader_hint=next:1" in e.details()
+
+        # CLI shaping: the leader-status row leads, sorted keys
+        from hstream_tpu.admin import cmd_promote, cmd_replicas
+
+        rows = cmd_replicas(stub, None)
+        assert rows[0]["role"] == "leader-status"
+        assert rows[0]["fenced"] is True
+
+        class _Args:
+            target = None
+            replicas = f"127.0.0.1:{fport}"
+            leader_addr = None
+
+        res2 = cmd_promote(stub, _Args)[0]
+        # leader-death path through the CLI: re-promoting the already
+        # promoted follower raises its epoch again
+        assert res2["ok"] and res2["epoch"] == 2
+
+        # promote with neither form is a loud usage error
+        try:
+            admin(stub, "promote")
+            raise AssertionError("argless promote accepted")
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.INTERNAL
+    finally:
+        channel.close()
+        server.stop(grace=1)
+        try:
+            ctx.shutdown()
+        except Exception:  # noqa: BLE001 — fenced store refuses final
+            pass           # status writes
+        svc.close()
+        fsrv.stop(grace=1)

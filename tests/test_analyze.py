@@ -508,6 +508,79 @@ def test_errcontract_complete_contract_clean():
     assert run_one(errcontract, files) == []
 
 
+HINTED_ERRORS_FIXTURE = '''
+import grpc
+
+class HStreamError(Exception):
+    grpc_status = grpc.StatusCode.INTERNAL
+
+class NotLeaderish(HStreamError):
+    grpc_status = grpc.StatusCode.UNAVAILABLE
+
+    def __init__(self, message="", leader_hint=None):
+        super().__init__(message)
+        self.leader_hint = leader_hint
+'''
+
+HINTED_HANDLERS_FIXTURE = '''
+def handler(context):
+    raise NotLeaderish("fenced", leader_hint="addr")
+'''
+
+
+def _hinted_files(retry_body: str):
+    gw = '''
+    import grpc
+
+    _STATUS = {grpc.StatusCode.UNAVAILABLE: 503,
+               grpc.StatusCode.INTERNAL: 500}
+    '''
+    return [
+        src(errcontract.ERRORS_FILE, HINTED_ERRORS_FIXTURE),
+        src("hstream_tpu/server/handlers.py", HINTED_HANDLERS_FIXTURE),
+        src(errcontract.GATEWAY_FILE, gw),
+        src(errcontract.RETRY_FILE, retry_body),
+    ]
+
+
+def test_errcontract_hinted_contract_clean():
+    """A hint-carrying class whose status is hinted-classified AND
+    bare-non-retryable passes all three hinted rules."""
+    files = _hinted_files('''
+    import grpc
+
+    RETRYABLE_CODES = frozenset()
+    NON_RETRYABLE_CODES = frozenset({grpc.StatusCode.UNAVAILABLE,
+                                     grpc.StatusCode.INTERNAL})
+    HINTED_RETRYABLE_CODES = frozenset({grpc.StatusCode.UNAVAILABLE})
+    ''')
+    assert run_one(errcontract, files) == []
+
+
+def test_errcontract_hinted_gaps_flagged():
+    """Unclassified hint status, a dead hinted code, and a hinted code
+    whose bare form escaped NON_RETRYABLE each fire their rule."""
+    files = _hinted_files('''
+    import grpc
+
+    RETRYABLE_CODES = frozenset()
+    NON_RETRYABLE_CODES = frozenset({grpc.StatusCode.INTERNAL})
+    HINTED_RETRYABLE_CODES = frozenset({grpc.StatusCode.ABORTED})
+    ''')
+    out = run_one(errcontract, files)
+    rules = {f.rule for f in out}
+    # UNAVAILABLE (the hint class's status) is not hinted-classified
+    assert "err-hinted-unclassified" in rules
+    # ABORTED is hinted but no hint class emits it
+    assert "err-dead-hint" in rules
+    # ABORTED's bare form is not in NON_RETRYABLE_CODES
+    assert "err-hinted-bare" in rules
+    # the hinted check scopes to RAISED hint classes only: INTERNAL
+    # (the base class, never raised) must not fire it
+    assert not any("INTERNAL" in f.message for f in out
+                   if f.rule == "err-hinted-unclassified")
+
+
 def test_errcontract_real_tree_tables_agree():
     """Table-driven check against the LIVE modules: every status the
     server can emit has an HTTP mapping and a retryability class, and
@@ -533,6 +606,12 @@ def test_errcontract_real_tree_tables_agree():
     # the classification itself is coherent
     assert not (retryable & non_retryable)
     assert grpc.StatusCode.RESOURCE_EXHAUSTED in retry_mod.RETRYABLE_CODES
+    # the NOT_LEADER contract (ISSUE 9): hinted codes are an overlay on
+    # non-retryable — followable only WITH a hint, never blanket-retried
+    hinted = {c.name for c in retry_mod.HINTED_RETRYABLE_CODES}
+    assert hinted <= non_retryable
+    assert grpc.StatusCode.UNAVAILABLE in retry_mod.HINTED_RETRYABLE_CODES
+    assert "UNAVAILABLE" in emitted  # NotLeaderError is raised for real
 
 
 # ---- lifecycle -------------------------------------------------------------

@@ -551,6 +551,456 @@ def test_registry_inactive_is_identity_and_env_parses():
         reg.arm("x", "fail")
 
 
+# ---- multi-node failover (ISSUE 9): epoch fencing, promotion, dedup ---------
+
+
+import random
+import socket
+
+from hstream_tpu.client.retry import RetryPolicy
+from hstream_tpu.store import open_store
+from hstream_tpu.store.replica import (
+    OPLOG_ID,
+    ReplicatedStore,
+    promote_best,
+    seal_replicas,
+    serve_follower,
+)
+from hstream_tpu.store.api import DataBatch
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _log_contents(store, logid):
+    tail = store.tail_lsn(logid)
+    if tail == 0:
+        return []
+    r = store.new_reader()
+    r.set_timeout(0)
+    r.start_reading(logid, 1, tail)
+    out = []
+    while True:
+        items = r.read(512)
+        if not items:
+            break
+        for it in items:
+            if isinstance(it, DataBatch):
+                out.append((it.lsn, tuple(it.payloads)))
+    return out
+
+
+def _store_fingerprint(store):
+    """Byte-level identity of a replica's REPLICATED state: every data
+    log's full contents plus every meta key except the replica-local
+    leadership binding (each node records its own epoch/role/node id).
+    Two converged replicas must compare equal on this."""
+    logs = {lid: _log_contents(store, lid) for lid in store.list_logs()
+            if lid != OPLOG_ID}
+    meta = {}
+    for key in store.meta_list(""):
+        if key.startswith("replica/"):
+            continue
+        meta[key] = store.meta_get(key)
+    return {"logs": logs, "meta": meta}
+
+
+class _ReplicaGroup:
+    """One leader SQL server over a mem store + N in-process follower
+    replica services, with teardown that survives partial failover."""
+
+    def __init__(self, n_followers=2, ack_timeout_ms=2000):
+        self.followers = []
+        for i in range(n_followers):
+            st = open_store("mem://")
+            port = _free_port()
+            addr = f"127.0.0.1:{port}"
+            srv, svc = serve_follower(st, addr, node_id=f"replica-{i}")
+            self.followers.append(
+                {"store": st, "srv": srv, "svc": svc, "addr": addr})
+        self.server, self.ctx = serve(
+            "127.0.0.1", 0, "mem://",
+            replicate=",".join(f["addr"] for f in self.followers),
+            replication_factor=1 + n_followers,
+            replica_ack_timeout_ms=ack_timeout_ms)
+        self.addr = f"127.0.0.1:{self.ctx.port}"
+        self.channel = grpc.insecure_channel(self.addr)
+        self.stub = HStreamApiStub(self.channel)
+        # set when a follower is re-served as the new leader
+        self.new_server = None
+        self.new_ctx = None
+
+    def follower(self, addr):
+        return next(f for f in self.followers if f["addr"] == addr)
+
+    def caught_up(self):
+        seq = self.ctx.store.oplog_seq
+        return all(f["svc"].applied_seq >= seq for f in self.followers)
+
+    def close(self):
+        self.channel.close()
+        self.server.stop(grace=1)
+        try:
+            self.ctx.shutdown()
+        except Exception:  # noqa: BLE001 — a fenced store refuses the
+            pass           # final status writes; teardown must go on
+        if self.new_server is not None:
+            self.new_server.stop(grace=1)
+            try:
+                self.new_ctx.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        for f in self.followers:
+            f["svc"].close()
+            f["srv"].stop(grace=1)
+
+
+class _Producer:
+    """Append client with a stamped (producer_id, seq) and a retry
+    policy that follows NOT_LEADER hints by rebinding its channel —
+    the failover-aware client contract, driven raw for determinism."""
+
+    def __init__(self, addr, producer_id="prod-1", seed=7):
+        self.addr = addr
+        self.producer_id = producer_id
+        self.channel = grpc.insecure_channel(addr)
+        self.stub = HStreamApiStub(self.channel)
+        self.policy = RetryPolicy(attempts=6, base_ms=5,
+                                  rng=random.Random(seed))
+
+    def _follow(self, hint):
+        old = self.channel
+        self.addr = hint
+        self.channel = grpc.insecure_channel(hint)
+        self.stub = HStreamApiStub(self.channel)
+        old.close()
+
+    def append(self, stream, row, seq):
+        req = pb.AppendRequest(stream_name=stream,
+                               producer_id=self.producer_id,
+                               producer_seq=seq)
+        req.records.append(rec.build_record(row, publish_time_ms=BASE))
+
+        def attempt(r):
+            return self.stub.Append(r)
+
+        return self.policy.call(attempt, req,
+                                on_leader_hint=self._follow)
+
+    def close(self):
+        self.channel.close()
+
+
+def test_leader_failover_retrying_producer_exact_once():
+    """THE ISSUE 9 acceptance scenario: the leader loses leadership
+    mid-append-stream (a follower is promoted out from under it), the
+    retrying producer follows the NOT_LEADER hint to the new leader,
+    the retry that straddles the promotion lands EXACTLY once, and the
+    surviving replicas converge byte-identical."""
+    g = _ReplicaGroup(n_followers=2)
+    prod = _Producer(g.addr)
+    try:
+        g.stub.CreateStream(pb.Stream(stream_name="fo1"))
+        lsns = {}
+        for seq in (1, 2, 3):
+            resp = prod.append("fo1", {"n": seq}, seq)
+            assert not resp.duplicate
+            lsns[seq] = resp.record_ids[0].batch_id
+        assert _wait(g.caught_up), "followers never caught up"
+
+        # leadership moves: promote the most-caught-up follower, with
+        # the hint naming the NEW SQL server we boot over its store
+        new_port = _free_port()
+        promo = promote_best([f["addr"] for f in g.followers],
+                             leader_addr=f"127.0.0.1:{new_port}")
+        assert promo["ok"] and promo["epoch"] == 1  # 0 everywhere + 1
+        # most-caught-up rule: equal (epoch, applied_seq) -> highest
+        # node id wins the tiebreak
+        assert promo["node_id"] == "replica-1"
+        # the OTHER follower was sealed at the new epoch immediately
+        other = next(f for f in g.followers
+                     if f["addr"] != promo["target"])
+        assert promo["sealed"] == [other["addr"]]
+        assert other["svc"].epoch == promo["epoch"]
+
+        winner = g.follower(promo["target"])
+        g.new_server, g.new_ctx = serve(
+            "127.0.0.1", new_port, store=winner["store"],
+            replicate=other["addr"], replication_factor=2,
+            replica_ack_timeout_ms=2000)
+        assert g.new_ctx.store.epoch == promo["epoch"]
+        assert g.new_ctx.store.node_id == "replica-1"
+
+        # the old leader discovers the fence on its next contact
+        assert _wait(lambda: g.ctx.store.fenced_by is not None,
+                     timeout=15), "old leader never fenced"
+        assert g.ctx.store.fenced_by[0] == promo["epoch"]
+
+        # the producer retries seq=3 (its ack raced the failover) and
+        # continues with 4..5: attempt 1 hits the fenced leader, gets
+        # NOT_LEADER + hint, follows it — exactly-once throughout
+        r3 = prod.append("fo1", {"n": 3}, 3)
+        assert prod.policy.leader_follows >= 1
+        assert prod.addr == f"127.0.0.1:{new_port}"
+        assert r3.duplicate, "retry across failover must dedup"
+        assert r3.record_ids[0].batch_id == lsns[3]
+        for seq in (4, 5):
+            resp = prod.append("fo1", {"n": seq}, seq)
+            assert not resp.duplicate
+            lsns[seq] = resp.record_ids[0].batch_id
+
+        # survivors converge byte-identical, with exactly 5 batches
+        new_store = g.new_ctx.store
+        assert _wait(lambda: other["svc"].applied_seq
+                     >= new_store.oplog_seq), "peer never converged"
+        logid = g.new_ctx.streams.get_logid("fo1")
+        want = _log_contents(new_store.local, logid)
+        assert len(want) == 5 and want[-1][0] == lsns[5]
+        assert _log_contents(other["store"], logid) == want
+        assert _store_fingerprint(other["store"]) == \
+            _store_fingerprint(new_store.local)
+
+        # observability: the dedup answered append is counted, the old
+        # leader journals its fencing, epoch/dedup gauges render
+        assert g.new_ctx.stats.stream_stat_get("append_deduped",
+                                               "fo1") == 1
+        assert g.ctx.store.fenced_appends >= 1
+        assert "replica_fenced" in _event_kinds(g.ctx)
+        from hstream_tpu.stats.prometheus import render_metrics
+
+        text = render_metrics(g.new_ctx)
+        assert f"hstream_replica_epoch {promo['epoch']}" in text
+        assert "hstream_dedup_window_size 5" in text
+        assert 'hstream_append_deduped_total{stream="fo1"} 1' in text
+    finally:
+        prod.close()
+        g.close()
+
+
+def test_stale_leader_partition_appends_fenced_not_replicated():
+    """replica.partition drops every Replicate: the partitioned
+    leader's appends land only on its own store (honestly degraded).
+    A follower promoted during the partition fences it — its
+    post-fence appends are REJECTED, the orphan entry never reaches a
+    survivor, and a raw stale-epoch Replicate is answered fenced."""
+    g = _ReplicaGroup(n_followers=2, ack_timeout_ms=600)
+    try:
+        g.stub.CreateStream(pb.Stream(stream_name="pt1"))
+        req = pb.AppendRequest(stream_name="pt1")
+        req.records.append(rec.build_record({"n": 1},
+                                            publish_time_ms=BASE))
+        g.stub.Append(req)
+        assert _wait(g.caught_up)
+        logid = g.ctx.streams.get_logid("pt1")
+
+        # partition: every leader->follower Replicate now fails
+        FAULTS.arm("replica.partition", "fail:1:100000")
+        req = pb.AppendRequest(stream_name="pt1")
+        req.records.append(rec.build_record({"n": "orphan"},
+                                            publish_time_ms=BASE))
+        g.stub.Append(req)  # degraded ack: landed on the leader only
+        assert g.ctx.store.last_ack_status.startswith("degraded")
+
+        # promotion while partitioned: Promote is a different RPC, so
+        # the operator can still move leadership; the seal RPCs ride
+        # Replicate and are blocked — best-effort, reported as such
+        promo = promote_best([f["addr"] for f in g.followers],
+                             leader_addr="127.0.0.1:1")
+        assert promo["ok"] and promo["sealed"] == []
+        FAULTS.disarm("replica.partition")
+        # operator retries the seal once the link heals
+        other = next(f for f in g.followers
+                     if f["addr"] != promo["target"])
+        assert seal_replicas([other["addr"]], epoch=promo["epoch"],
+                             leader_id=promo["node_id"],
+                             leader_hint="127.0.0.1:1") == \
+            [other["addr"]]
+
+        assert _wait(lambda: g.ctx.store.fenced_by is not None,
+                     timeout=20), "stale leader never fenced"
+        # post-fence appends are refused with the hint, not stored
+        tail_before = g.ctx.store.local.tail_lsn(logid)
+        req = pb.AppendRequest(stream_name="pt1")
+        req.records.append(rec.build_record({"n": "rejected"},
+                                            publish_time_ms=BASE))
+        try:
+            g.stub.Append(req)
+            raise AssertionError("fenced leader accepted an append")
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.UNAVAILABLE
+            assert "not_leader leader_hint=127.0.0.1:1" in e.details()
+            md = dict(e.trailing_metadata() or ())
+            assert md.get("x-leader-hint") == "127.0.0.1:1"
+        assert g.ctx.store.local.tail_lsn(logid) == tail_before
+
+        # neither survivor ever saw the orphan or the rejected append
+        for f in g.followers:
+            assert len(_log_contents(f["store"], logid)) == 1
+        # and a stale-epoch Replicate is fenced explicitly, with the
+        # hint pointing at the promotion's leader_addr
+        with grpc.insecure_channel(other["addr"]) as ch:
+            from hstream_tpu.proto.rpc import StoreReplicaStub
+
+            resp = StoreReplicaStub(ch).Replicate(
+                pb.ReplicateRequest(
+                    entries=[pb.LogEntry(seq=99, op=pb.OP_CREATE_LOG,
+                                         logid=77)],
+                    leader_id=g.ctx.store.node_id, epoch=0),
+                timeout=5)
+        assert resp.fenced and resp.epoch == promo["epoch"]
+        assert resp.leader_hint == "127.0.0.1:1"
+        assert not other["store"].log_exists(77)
+        assert g.ctx.store.fenced_appends >= 1
+        assert "replica_fenced" in _event_kinds(g.ctx)
+    finally:
+        g.close()
+
+
+def test_dueling_promotions_resolve_to_one_leader():
+    """Two operators promote two followers at the SAME epoch (the
+    promote.race window, widened by the armed delay site). First
+    contact resolves deterministically — the lexicographically higher
+    node id keeps leadership, the other demotes and follows — so the
+    group can never run two same-epoch leaders."""
+    g = _ReplicaGroup(n_followers=2)
+    try:
+        assert _wait(g.caught_up)
+        FAULTS.arm("replica.promote.race", "delay:30")
+        from hstream_tpu.proto.rpc import StoreReplicaStub
+
+        # both promotions race to epoch 1 and both "succeed"
+        for f in g.followers:
+            with grpc.insecure_channel(f["addr"]) as ch:
+                resp = StoreReplicaStub(ch).Promote(
+                    pb.PromoteRequest(epoch=1, leader_addr=f["addr"],
+                                      promoted_by="race"),
+                    timeout=5)
+            assert resp.ok
+        FAULTS.disarm("replica.promote.race")
+        lo, hi = g.followers[0], g.followers[1]  # replica-0 < replica-1
+        assert lo["svc"].is_leader and hi["svc"].is_leader
+
+        # first contact between the duelists: the seal each new leader
+        # sends carries (epoch, node_id); the lower id must stand down
+        assert seal_replicas([lo["addr"]], epoch=1,
+                             leader_id=hi["svc"].node_id,
+                             leader_hint=hi["addr"]) == [lo["addr"]]
+        assert not lo["svc"].is_leader
+        assert lo["store"].meta_get("replica/leader_id") == \
+            hi["svc"].node_id.encode()
+        # ... and the loser's own seal bounces off the winner
+        with grpc.insecure_channel(hi["addr"]) as ch:
+            resp = StoreReplicaStub(ch).Replicate(
+                pb.ReplicateRequest(entries=[], epoch=1,
+                                    leader_id=lo["svc"].node_id,
+                                    leader_hint=lo["addr"]),
+                timeout=5)
+        assert resp.fenced
+        assert hi["svc"].is_leader
+        assert [f["svc"].is_leader for f in g.followers] == [False, True]
+    finally:
+        g.close()
+
+
+def test_follower_divergence_guard_halts_loudly():
+    """ISSUE 9 satellite: a follower whose local store drifted from
+    the op-log (its data log was corrupted out-of-band) must HALT with
+    the divergence error — refusing every further entry, applying
+    nothing, never growing the corrupt log — instead of drifting."""
+    g = _ReplicaGroup(n_followers=2)
+    try:
+        g.stub.CreateStream(pb.Stream(stream_name="dv1"))
+        for n in (1, 2):
+            req = pb.AppendRequest(stream_name="dv1")
+            req.records.append(rec.build_record({"n": n},
+                                                publish_time_ms=BASE))
+            g.stub.Append(req)
+        assert _wait(g.caught_up)
+        logid = g.ctx.streams.get_logid("dv1")
+        bad, good = g.followers[0], g.followers[1]
+
+        # corrupt ONE follower: its data log loses its records, so the
+        # next replicated append expects lsn 3 over a tail of 0
+        bad["store"].remove_log(logid)
+        bad["store"].create_log(logid)
+        frozen_seq = bad["svc"].applied_seq
+        req = pb.AppendRequest(stream_name="dv1")
+        req.records.append(rec.build_record({"n": 3},
+                                            publish_time_ms=BASE))
+        g.stub.Append(req)  # acked by the good follower
+
+        # the corrupt follower halted: applied_seq frozen, nothing
+        # landed in the recreated log, and it now refuses EVERYTHING
+        assert _wait(lambda: bad["svc"]._broken is not None,
+                     timeout=15), "divergence never latched"
+        assert "diverged" in str(bad["svc"]._broken)
+        assert bad["svc"].applied_seq == frozen_seq
+        assert _log_contents(bad["store"], logid) == []
+        from hstream_tpu.proto.rpc import StoreReplicaStub
+
+        with grpc.insecure_channel(bad["addr"]) as ch:
+            try:
+                StoreReplicaStub(ch).Replicate(
+                    pb.ReplicateRequest(
+                        entries=[], leader_id=g.ctx.store.node_id,
+                        epoch=0),
+                    timeout=5)
+                raise AssertionError("diverged replica accepted entries")
+            except grpc.RpcError as e:
+                assert e.code() == grpc.StatusCode.INTERNAL
+                assert "diverged" in (e.details() or "")
+        # the healthy follower carried on: all three records applied
+        assert _wait(lambda: good["svc"].applied_seq
+                     >= g.ctx.store.oplog_seq)
+        assert len(_log_contents(good["store"], logid)) == 3
+    finally:
+        g.close()
+
+
+def test_heartbeat_loss_triggers_lease_auto_promotion():
+    """replica.heartbeat.drop kills every idle-leader heartbeat: the
+    flag-gated lease monitor on the follower must promote it once the
+    leader goes silent past the lease, and the old leader must fence
+    itself on the next contact — leadership heals without an
+    operator."""
+    st = open_store("mem://")
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    fsrv, svc = serve_follower(st, addr, node_id="auto-f",
+                               lease_timeout_s=0.6)
+    leader = ReplicatedStore(open_store("mem://"), [addr],
+                             replication_factor=2, client_addr="old:1")
+    try:
+        leader.create_log(5)
+        leader.append(5, b"one")
+        assert _wait(lambda: svc.applied_seq >= leader.oplog_seq)
+        # heartbeats now die leader-side; the follower's lease expires
+        FAULTS.arm("replica.heartbeat.drop", "fail:1:100000")
+        assert _wait(lambda: svc.is_leader, timeout=20), \
+            "lease auto-promotion never fired"
+        assert svc.epoch >= 1
+        assert _wait(lambda: leader.fenced_by is not None, timeout=20)
+        assert leader.fenced_by[1] == addr  # hint = promoted follower
+        try:
+            leader.append(5, b"two")
+            raise AssertionError("fenced leader accepted an append")
+        except Exception as e:  # noqa: BLE001 — typed check below
+            from hstream_tpu.common.errors import NotLeaderError
+
+            assert isinstance(e, NotLeaderError)
+            assert e.leader_hint == addr
+    finally:
+        FAULTS.disarm()
+        leader.close()
+        svc.close()
+        fsrv.stop(grace=1)
+
+
 def test_registry_delay_schedule_sleeps_only_scheduled_hit():
     reg = FaultRegistry()
     reg.arm("x", "delay:40:2")

@@ -333,3 +333,175 @@ def test_flow_status_and_quota_admin_verbs(stack):
         _admin(stub, "quota-unset", scope="tenant/acme")
     got = _admin(stub, "quota-get", scope="tenant/acme")
     assert got.get("unset") is True
+
+
+# ---- ISSUE 9: failover-aware client + gateway (NOT_LEADER hint) -------------
+
+
+class _FencedServicer:
+    """Every RPC answers like a fenced store leader: UNAVAILABLE with
+    the new leader's address in trailing metadata AND the message."""
+
+    def __init__(self, hint: str):
+        self.hint = hint
+        self.hits = 0
+
+    def __getattr__(self, name):
+        def handler(request, context):
+            self.hits += 1
+            context.set_trailing_metadata(
+                (("x-leader-hint", self.hint),))
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"store leadership lost: fenced by epoch 2 "
+                f"(not_leader leader_hint={self.hint})")
+
+        return handler
+
+
+@pytest.fixture()
+def fenced_pair(stack):
+    """A fenced fake leader whose hint points at the REAL server."""
+    from concurrent import futures
+
+    from hstream_tpu.proto.rpc import add_hstream_api_to_server
+
+    addr, _http, _stub, _ctx = stack
+    fake = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    svc = _FencedServicer(addr)
+    add_hstream_api_to_server(svc, fake)
+    fport = fake.add_insecure_port("127.0.0.1:0")
+    fake.start()
+    yield f"127.0.0.1:{fport}", svc
+    fake.stop(grace=1)
+
+
+def test_retry_policy_follows_hint_only_with_callback_and_hint():
+    """Unit contract: UNAVAILABLE + hint retries through the callback;
+    bare UNAVAILABLE (no hint — a mid-call drop) raises immediately
+    even WITH a callback; hinted errors raise without a callback."""
+    from hstream_tpu.client.retry import (
+        HINTED_RETRYABLE_CODES,
+        RetryPolicy,
+        leader_hint_from_error,
+    )
+
+    class _Err(grpc.RpcError):
+        def __init__(self, details="", md=()):
+            self._d, self._md = details, md
+
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+        def details(self):
+            return self._d
+
+        def trailing_metadata(self):
+            return self._md
+
+    hinted = _Err(md=(("x-leader-hint", "new:1"),))
+    texted = _Err("x (not_leader leader_hint=new:2)")
+    bare = _Err("connection reset")
+    assert leader_hint_from_error(hinted) == "new:1"
+    assert leader_hint_from_error(texted) == "new:2"  # text fallback
+    assert leader_hint_from_error(bare) is None
+    assert grpc.StatusCode.UNAVAILABLE in HINTED_RETRYABLE_CODES
+
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise hinted
+        return "ok"
+
+    followed = []
+    policy = RetryPolicy(attempts=3, sleep=lambda s: None)
+    assert policy.call(fn, on_leader_hint=followed.append) == "ok"
+    assert followed == ["new:1"]
+    assert policy.leader_follows == 1
+
+    calls["n"] = 0
+    with pytest.raises(grpc.RpcError):  # no callback: not followable
+        RetryPolicy(attempts=3, sleep=lambda s: None).call(fn)
+
+    def always_bare():
+        raise bare
+
+    with pytest.raises(grpc.RpcError):  # hintless: never retried
+        policy.call(always_bare, on_leader_hint=followed.append)
+    assert followed == ["new:1"]  # callback not invoked again
+
+
+def test_client_follows_leader_hint_across_statements(fenced_pair,
+                                                      stack):
+    """The SQL client pointed at a fenced leader follows the hint mid-
+    statement: the CREATE lands on the new leader and the session stays
+    rebound for everything after."""
+    fenced_addr, svc = fenced_pair
+    addr, _http, stub, _ctx = stack
+    out = io.StringIO()
+    client = Client(fenced_addr, out=out)
+    try:
+        client.execute("CREATE STREAM failover_cli;")
+        assert client.addr == addr  # rebound to the hinted leader
+        assert client.retry.leader_follows == 1
+        assert svc.hits == 1
+        streams = {s.stream_name for s in stub.ListStreams(
+            pb.ListStreamsRequest()).streams}
+        assert "failover_cli" in streams
+        assert "following hint" in out.getvalue()
+        # the NEXT statement goes straight to the new leader
+        client.execute("CREATE STREAM failover_cli2;")
+        assert svc.hits == 1
+        assert client.retry.leader_follows == 1
+    finally:
+        client.close()
+
+
+def test_gateway_follows_leader_hint_and_rebinds(fenced_pair, stack):
+    """An HTTP caller behind the gateway never sees the failover: the
+    gateway follows the NOT_LEADER hint, retries the request against
+    the new leader, and keeps the rebound channel for later requests."""
+    from hstream_tpu.http_gateway import serve_gateway
+
+    fenced_addr, svc = fenced_pair
+    addr, _http_base, stub, _ctx = stack
+    httpd, gw = serve_gateway(fenced_addr, port=0)
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        code, payload = _http("POST", base, "/streams",
+                              {"name": "failover_gw"})
+        assert code == 201, payload
+        assert gw.leader_follows == 1
+        assert gw.server_addr == addr
+        assert svc.hits == 1
+        streams = {s.stream_name for s in stub.ListStreams(
+            pb.ListStreamsRequest()).streams}
+        assert "failover_gw" in streams
+        # next request rides the rebound channel directly
+        code, payload = _http("GET", base, "/streams")
+        assert code == 200
+        assert svc.hits == 1
+    finally:
+        httpd.shutdown()
+        gw.close()
+
+
+def test_gateway_surfaces_hint_when_retry_also_fails(fenced_pair):
+    """If the hinted leader is ALSO unreachable/fenced, the gateway
+    still answers 503 with the hint in the body so the HTTP caller can
+    act on it."""
+    from hstream_tpu.http_gateway import Gateway
+
+    fenced_addr, svc = fenced_pair
+    # a gateway whose fenced leader hints at... the same fenced leader
+    svc.hint = fenced_addr
+    gw = Gateway(fenced_addr)
+    try:
+        out = gw.handle("GET", "/streams", None)
+        assert out[0] == 503
+        assert out[1]["leader_hint"] == fenced_addr
+        assert len(out) == 2 or "x-follow-leader" not in (out[2] or {})
+    finally:
+        gw.close()

@@ -924,3 +924,174 @@ def test_query_labeled_counters_survive_live_stream_filter():
     assert 'hstream_query_restarts_total{stream="view-v1"} 1' in text
     text = render_holder(stats, live_streams={"src"}, live_queries=set())
     assert '"view-v1"' not in text
+
+
+# ---- ISSUE 9: epoch-fenced failover hardening -------------------------------
+
+
+def test_supervisor_stands_down_on_leadership_loss():
+    """A task that dies of NotLeaderError must NOT be restart-looped:
+    this node's store was fenced, every restart would die identically
+    and burn the crash-loop breaker. The supervisor stands down
+    (journaling the fencing) and leaves the replicated RUNNING record
+    for the new leader's boot to adopt; ordinary deaths still
+    schedule restarts."""
+    from hstream_tpu.common.errors import NotLeaderError
+    from hstream_tpu.server.persistence import QueryInfo
+    from hstream_tpu.server.scheduler import QuerySupervisor
+    from hstream_tpu.stats.events import EventJournal
+
+    ctx = _SupCtx()
+    ctx.events = EventJournal()
+    sup = QuerySupervisor(ctx)
+    info = QueryInfo("q-fenced", "select 1", 0)
+    try:
+        for _ in range(10):  # repeated fencing never opens the breaker
+            sup.note_death(info, NotLeaderError(
+                "store leadership lost", leader_hint="new:1"))
+        st = sup.status()
+        assert st["pending"] == {}
+        assert st["breaker_open"] == []
+        assert st["restarts"] == 0
+        events = ctx.events.query(kind="replica_fenced", limit=20)
+        assert events and events[0]["leader_hint"] == "new:1"
+        # a plain crash on the same query still schedules a restart
+        sup.note_death(info, RuntimeError("boom"))
+        assert "q-fenced" in sup.status()["pending"]
+    finally:
+        sup.shutdown()
+
+
+def test_supervisor_status_pending_is_sorted():
+    """Operator/chaos assertions diff `admin supervisor` output: the
+    pending map must come back sorted by query id, not in death
+    order."""
+    from hstream_tpu.server.persistence import QueryInfo
+    from hstream_tpu.server.scheduler import QuerySupervisor
+
+    ctx = _SupCtx()
+    clock = [100.0]
+    sup = QuerySupervisor(ctx, clock=lambda: clock[0])
+    try:
+        for qid in ("q-z", "q-a", "q-m"):
+            sup.note_death(QueryInfo(qid, "select 1", 0))
+        assert list(sup.status()["pending"]) == ["q-a", "q-m", "q-z"]
+    finally:
+        sup.shutdown()
+
+
+def test_replica_divergence_checked_before_mutation():
+    """_apply must detect an LSN mismatch BEFORE appending: the old
+    order landed the batch and then raised, so every sender retry of
+    the same entry grew the diverged replica's log further."""
+    import pytest
+
+    from hstream_tpu.common.errors import ReplicaDivergence
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import _apply
+
+    st = open_store("mem://")
+    st.create_log(9)
+    st.append(9, b"existing")
+    e = pb.LogEntry(op=pb.OP_APPEND, logid=9, payloads=[b"x"],
+                    expect_lsn=5)  # tail is 1; 5 expects tail 4
+    for _ in range(3):  # retries must not mutate either
+        with pytest.raises(ReplicaDivergence):
+            _apply(st, e)
+    assert st.tail_lsn(9) == 1  # nothing landed
+    st.close()
+
+def test_dedup_seq_zero_first_append_accepted():
+    """Review fix: the empty dedup watermark is -1, not 0 — seq 0 is a
+    legal first stamp (and the proto3 default when only producer_id is
+    set), so a 0-based producer's very first append must be accepted,
+    not refused ALREADY_EXISTS as an evicted duplicate."""
+    from hstream_tpu.store import dedup, open_store
+
+    st = open_store("mem://")
+    assert dedup.lookup(st, "p-zero", 0) is None  # new, not duplicate
+    dedup.record(st, "p-zero", 0, 17, 3)
+    assert dedup.lookup(st, "p-zero", 0) == (17, 3)  # now remembered
+    st.close()
+
+
+def test_malformed_producer_seq_refused_not_unstamped():
+    """Review fix: a stamped ExecuteQuery whose x-producer-seq does not
+    parse must be refused INVALID_ARGUMENT — silently running the
+    INSERT unstamped would let the client's retry double-append while
+    it believes it has exactly-once."""
+    import pytest
+
+    from hstream_tpu.common.errors import SQLValidateError
+    from hstream_tpu.server.handlers import _producer_from
+
+    class _Ctx:
+        def __init__(self, md):
+            self._md = md
+
+        def invocation_metadata(self):
+            return self._md
+
+    with pytest.raises(SQLValidateError):
+        _producer_from(_Ctx([("x-producer-id", "p1"),
+                             ("x-producer-seq", "0x2a")]))
+    # well-formed stamp still parses; absent stamp still None
+    assert _producer_from(_Ctx([("x-producer-id", "p1"),
+                                ("x-producer-seq", "42")])) == ("p1", 42)
+    assert _producer_from(_Ctx([])) is None
+
+
+def test_auto_promote_lease_floored_above_heartbeat():
+    """Review fix: a lease below the idle-heartbeat cadence would fence
+    a healthy idle leader between two heartbeats — FollowerService
+    clamps it to 3x _HEARTBEAT_S."""
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import _HEARTBEAT_S, FollowerService
+
+    st = open_store("mem://")
+    svc = FollowerService(st, node_id="floor-f", lease_timeout_s=0.05)
+    try:
+        assert svc.lease_timeout_s == _HEARTBEAT_S * 3
+    finally:
+        svc.close()
+        st.close()
+
+
+def test_auto_promotion_hint_prefers_advertise_addr():
+    """Review fix: the auto-promotion leader hint must be the
+    client-facing SQL address (--advertise-addr), not the replica's
+    StoreReplica listen port — a client following the raw replica
+    address would fail UNIMPLEMENTED."""
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import FollowerService
+
+    st = open_store("mem://")
+    svc = FollowerService(st, node_id="adv-f", listen_addr="repl:1",
+                          advertise_addr="sql:1")
+    try:
+        svc._promote_locked(1, "", "lease-timeout")
+        assert svc._leader_hint == "sql:1"
+        info = svc.ReplicaInfo(pb.ReplicaInfoRequest(), None)
+        assert info.leader_hint == "sql:1"
+    finally:
+        svc.close()
+        st.close()
+
+
+def test_gateway_rebind_retires_old_channel_instead_of_closing():
+    """Review fix: the gateway's leader-hint rebind must not close the
+    shared channel out from under concurrent handler threads mid-RPC —
+    the old channel is retired and closed only at gateway shutdown."""
+    from hstream_tpu.http_gateway import Gateway
+
+    gw = Gateway("127.0.0.1:1")
+    old = gw.channel
+    gw._follow_leader_hint("127.0.0.1:2")
+    assert gw.server_addr == "127.0.0.1:2"
+    assert gw.channel is not old and gw._retired == [old]
+    assert gw.leader_follows == 1
+    # same-hint re-follow is a no-op (concurrent callers rebind once)
+    gw._follow_leader_hint("127.0.0.1:2")
+    assert gw.leader_follows == 1
+    gw.close()
+    assert gw._retired == []

@@ -447,3 +447,160 @@ def test_leader_restart_keeps_feeding_followers(tmp_path):
         leader.close()
     finally:
         server.stop(grace=1)
+
+
+# ---- ISSUE 9: epoch-fenced failover + idempotent appends --------------------
+
+
+def test_dedup_window_semantics_single_store():
+    """Window contract (store/dedup.py): new seq appends + records,
+    remembered seq answers the ORIGINAL (lsn, n), and a seq at/below
+    the watermark but evicted from the bounded window refuses loudly
+    (DuplicateAppend) instead of silently re-appending."""
+    import threading
+
+    import pytest
+
+    from hstream_tpu.common.errors import DuplicateAppend
+    from hstream_tpu.store import dedup
+    from hstream_tpu.store.api import Compression
+
+    st = open_store("mem://")
+    st.create_log(3)
+    lock = threading.Lock()
+
+    def app(seq, payloads):
+        return dedup.guarded_append(st, lock, 3, payloads,
+                                    Compression.NONE, "p1", seq)
+
+    lsn1, n1, dup1 = app(1, [b"a", b"b"])
+    assert (n1, dup1) == (2, False)
+    # retry: original ids, nothing re-stored
+    assert app(1, [b"a", b"b"]) == (lsn1, 2, True)
+    assert st.tail_lsn(3) == lsn1
+    # fill past the window; seq 1 falls off
+    for seq in range(2, dedup.DEDUP_WINDOW + 3):
+        app(seq, [b"x"])
+    with pytest.raises(DuplicateAppend):
+        app(1, [b"a", b"b"])
+    # independent producers keep independent windows
+    assert dedup.guarded_append(st, lock, 3, [b"y"], Compression.NONE,
+                                "p2", 1)[2] is False
+    assert dedup.window_size(st) == dedup.DEDUP_WINDOW + 1
+    st.close()
+
+
+def test_dedup_window_replicates_with_the_oplog():
+    """The producer stamp rides the replicated LogEntry: after
+    convergence the follower's dedup window is byte-identical to the
+    leader's — a promoted follower can answer a producer's retry with
+    the original LSN (the exactly-once-across-failover invariant)."""
+    from hstream_tpu.store import dedup
+    from hstream_tpu.store.api import Compression
+
+    follower_store = open_store("mem://")
+    port = free_port()
+    server, svc = serve_follower(follower_store, f"127.0.0.1:{port}")
+    leader = ReplicatedStore(open_store("mem://"),
+                             [f"127.0.0.1:{port}"],
+                             replication_factor=2)
+    try:
+        leader.create_log(4)
+        lsn, n, dup = leader.append_batch_dedup(
+            4, [b"r1", b"r2"], Compression.NONE,
+            producer_id="pp", producer_seq=1)
+        assert (n, dup) == (2, False)
+        # a racing retry on the SAME leader is answered from the window
+        assert leader.append_batch_dedup(
+            4, [b"r1", b"r2"], Compression.NONE,
+            producer_id="pp", producer_seq=1) == (lsn, 2, True)
+        wait_caught_up(leader, port)
+        assert follower_store.meta_get("dedup/pp") == \
+            leader.local.meta_get("dedup/pp")
+        # the follower (a promotion candidate) would answer the retry
+        # with the original lsn, straight from its replicated window
+        assert dedup.lookup(follower_store, "pp", 1) == (lsn, 2)
+    finally:
+        leader.close()
+        server.stop(grace=1)
+
+
+def test_planned_handoff_fences_seals_and_demoted_rejoins():
+    """admin promote --target end-to-end at the store layer: the old
+    leader fences itself (typed NotLeaderError + hint), the OTHER
+    follower is sealed at the new epoch in the same verb, and the
+    demoted leader rejoins as a follower of the new leader through the
+    ordinary catch-up path — every store converges identically."""
+    from hstream_tpu.common.errors import NotLeaderError
+
+    f1_store, f2_store = open_store("mem://"), open_store("mem://")
+    p1, p2, pr = free_port(), free_port(), free_port()
+    s1, svc1 = serve_follower(f1_store, f"127.0.0.1:{p1}",
+                              node_id="hand-f1")
+    s2, svc2 = serve_follower(f2_store, f"127.0.0.1:{p2}",
+                              node_id="hand-f2")
+    leader = ReplicatedStore(
+        open_store("mem://"),
+        [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"], replication_factor=3)
+    new_leader = None
+    rejoin_srv = None
+    try:
+        leader.create_log(6)
+        for i in range(3):
+            leader.append(6, f"pre-{i}".encode())
+        wait_caught_up(leader, p1)
+        wait_caught_up(leader, p2)
+
+        res = leader.promote_follower(f"127.0.0.1:{p1}",
+                                      leader_addr="client-new:1")
+        assert res["ok"] and res["node_id"] == "hand-f1"
+        assert res["sealed"] == [f"127.0.0.1:{p2}"]
+        assert svc1.is_leader and svc1.epoch == 1
+        assert svc2.epoch == 1 and not svc2.is_leader
+        # the demoted leader refuses mutations with the typed hint
+        try:
+            leader.append(6, b"stale")
+            raise AssertionError("fenced leader accepted an append")
+        except NotLeaderError as e:
+            assert e.leader_hint == "client-new:1"
+        assert leader.fenced_appends == 1
+        assert leader.leader_status()["fenced"] is True
+
+        # the demoted node rejoins as a FOLLOWER over its own store;
+        # the new leader (over f1's store, same persisted identity)
+        # catches it up through the normal path
+        rejoin_srv, rejoin_svc = serve_follower(
+            leader.local, f"127.0.0.1:{pr}", node_id="demoted")
+        new_leader = ReplicatedStore(
+            f1_store, [f"127.0.0.1:{p2}", f"127.0.0.1:{pr}"],
+            replication_factor=3, client_addr="client-new:1")
+        assert new_leader.epoch == 1
+        assert new_leader.node_id == "hand-f1"
+        for i in range(3):
+            new_leader.append(6, f"post-{i}".encode())
+        wait_caught_up(new_leader, p2)
+        wait_caught_up(new_leader, pr)
+        want = log_contents(new_leader.local, 6)
+        assert len(want) == 6
+        assert log_contents(f2_store, 6) == want
+        assert log_contents(leader.local, 6) == want
+        rejoin_srv.stop(grace=1)
+        rejoin_svc.close()
+        rejoin_srv = None
+    finally:
+        if new_leader is not None:
+            # new_leader shares f1_store; close only the replication
+            # machinery of the original leader afterwards
+            new_leader._stop.set()
+            for f in new_leader._followers:
+                f._thread.join(timeout=2)
+            new_leader._async_pool.shutdown(wait=True)
+        if rejoin_srv is not None:
+            rejoin_srv.stop(grace=1)
+        leader.close()
+        svc1.close()
+        svc2.close()
+        s1.stop(grace=1)
+        s2.stop(grace=1)
+        f1_store.close()
+        f2_store.close()

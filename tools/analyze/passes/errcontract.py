@@ -20,7 +20,18 @@ Contracts checked:
   err-dead-retry  every status the client retries on is actually
                   emitted server-side (or is transport-generated:
                   UNAVAILABLE / DEADLINE_EXCEEDED / CANCELLED, which
-                  the gRPC runtime raises without server code).
+                  the gRPC runtime raises without server code);
+  err-hinted-*    the NOT_LEADER contract (ISSUE 9): an error class
+                  that carries a ``leader_hint`` rides a status the
+                  client follows ONLY when the hint is present
+                  (HINTED_RETRYABLE_CODES). Three directions: every
+                  hint-carrying class's status is in the hinted set
+                  (else failover fails the statement instead of
+                  following), every hinted code is emitted by some
+                  hint-carrying class (no dead hint-follow paths), and
+                  every hinted code stays in NON_RETRYABLE_CODES so
+                  its BARE form — a mid-call transport drop that may
+                  have landed a mutation — is never blanket-retried.
 """
 
 from __future__ import annotations
@@ -42,6 +53,17 @@ RULES = {
     "err-dead-retry": (
         "client retries a status code no server path emits "
         "(transport-generated codes are exempt)"),
+    "err-hinted-unclassified": (
+        "status emitted by a leader-hint-carrying error class is not "
+        "in client.retry.HINTED_RETRYABLE_CODES (failover would fail "
+        "the statement instead of following the hint)"),
+    "err-dead-hint": (
+        "HINTED_RETRYABLE_CODES contains a status no hint-carrying "
+        "error class emits"),
+    "err-hinted-bare": (
+        "hinted-retryable status is missing from NON_RETRYABLE_CODES "
+        "— its bare (hintless) form could be blanket-retried, which "
+        "can double-apply a mutation landed by a mid-call drop"),
 }
 
 ERRORS_FILE = "hstream_tpu/common/errors.py"
@@ -92,8 +114,29 @@ def _error_classes(tree: ast.Module) -> dict[str, str]:
     return {name: resolve(name) for name in own}
 
 
-def _emitted(files, classes: dict[str, str]) -> dict[str, tuple[str, int]]:
-    """status -> one representative (path, line) where it is emitted."""
+def _hint_classes(tree: ast.Module) -> set[str]:
+    """Error classes that carry a leader hint: any method assigns
+    ``self.leader_hint`` (the NOT_LEADER shape)."""
+    out: set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Store)
+                    and sub.attr == "leader_hint"
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"):
+                out.add(node.name)
+                break
+    return out
+
+
+def _emitted(files, classes: dict[str, str], *,
+             include_aborts: bool = True) -> dict[str, tuple[str, int]]:
+    """status -> one representative (path, line) where it is emitted.
+    `include_aborts=False` restricts to raises of `classes` (the
+    hinted-contract check scopes emission to hint-carrying classes)."""
     out: dict[str, tuple[str, int]] = {}
     for src in files:
         if not src.rel.startswith("hstream_tpu/"):
@@ -106,7 +149,7 @@ def _emitted(files, classes: dict[str, str]) -> dict[str, tuple[str, int]]:
                 leaf = (name or "").split(".")[-1]
                 if leaf in classes:
                     out.setdefault(classes[leaf], (src.rel, node.lineno))
-            elif isinstance(node, ast.Call):
+            elif include_aborts and isinstance(node, ast.Call):
                 cn = dotted(node.func) or ""
                 if cn.endswith(".abort") and node.args:
                     st = _status_of(node.args[0])
@@ -133,7 +176,8 @@ def _gateway_map(src) -> tuple[set[str], int]:
 
 def _retry_sets(src) -> tuple[dict[str, set[str]], int]:
     out: dict[str, set[str]] = {"RETRYABLE_CODES": set(),
-                                "NON_RETRYABLE_CODES": set()}
+                                "NON_RETRYABLE_CODES": set(),
+                                "HINTED_RETRYABLE_CODES": set()}
     line = 1
     for node in src.tree.body:
         if isinstance(node, ast.Assign):
@@ -183,4 +227,28 @@ def run(files, repo) -> list[Finding]:
             out.append(Finding(
                 "err-dead-retry", RETRY_FILE, retry_line,
                 f"client retries {st} but no server path emits it"))
+    # the NOT_LEADER hinted contract (ISSUE 9): statuses followable
+    # only WITH a leader hint agree with the hint-carrying classes
+    hinted = retry_sets["HINTED_RETRYABLE_CODES"]
+    hint_emitted = _emitted(
+        files, {c: s for c, s in classes.items()
+                if c in _hint_classes(errors.tree)},
+        include_aborts=False)
+    for st, (path, _line) in sorted(hint_emitted.items()):
+        if st not in hinted:
+            out.append(Finding(
+                "err-hinted-unclassified", RETRY_FILE, retry_line,
+                f"status {st} (hint-carrying, emitted in {path}) is "
+                f"not in HINTED_RETRYABLE_CODES"))
+    for st in sorted(hinted):
+        if st not in hint_emitted:
+            out.append(Finding(
+                "err-dead-hint", RETRY_FILE, retry_line,
+                f"client follows hints on {st} but no hint-carrying "
+                f"error class emits it"))
+        if st not in retry_sets["NON_RETRYABLE_CODES"]:
+            out.append(Finding(
+                "err-hinted-bare", RETRY_FILE, retry_line,
+                f"hinted status {st} must stay in NON_RETRYABLE_CODES "
+                f"(bare form may follow a landed mutation)"))
     return out

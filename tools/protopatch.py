@@ -1,0 +1,215 @@
+"""Regenerate ``hstream_tpu/proto/api_pb2.py`` without protoc.
+
+The image carries neither ``protoc`` nor ``grpcio-tools``, but the
+checked-in ``api_pb2.py`` is nothing more than a serialized
+``FileDescriptorProto`` handed to the protobuf builder — so schema
+evolution is a descriptor-level edit: parse the current blob, apply the
+declarative edits below (idempotently — a field/message/method that
+already exists is skipped), serialize, and rewrite the module.
+
+Run from the repo root after editing the EDITS tables::
+
+    python -m tools.protopatch          # rewrites api_pb2.py in place
+    python -m tools.protopatch --check  # exit 1 if edits are unapplied
+
+Keep ``proto/api.proto`` (the human-readable source of truth) in sync
+by hand; CI imports the module and the dynamic rpc glue builds stubs
+straight off the descriptor, so a drifted blob fails loudly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from google.protobuf import descriptor_pb2 as dpb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PB2 = os.path.join(REPO, "hstream_tpu", "proto", "api_pb2.py")
+
+T = dpb.FieldDescriptorProto
+
+# message -> [(name, number, type)] appended if absent (proto3 singular)
+NEW_FIELDS = {
+    "AppendRequest": [
+        # idempotent producers (ISSUE 9): a client that stamps a
+        # monotone (producer_id, seq) on its appends can retry across
+        # leader failover — the server answers a remembered duplicate
+        # with the ORIGINAL record ids instead of re-appending
+        ("producer_id", 3, T.TYPE_STRING),
+        ("producer_seq", 4, T.TYPE_UINT64),
+    ],
+    "AppendResponse": [
+        # True when the append was answered from the dedup window (the
+        # record_ids are the original append's)
+        ("duplicate", 3, T.TYPE_BOOL),
+    ],
+    "LogEntry": [
+        # idempotent appends: the producer stamp rides the replicated
+        # entry itself, so every replica derives the SAME dedup window
+        # from the op-log — a retry that straddles a promotion is
+        # deduplicated by the new leader without any extra round trip
+        ("producer_id", 13, T.TYPE_STRING),
+        ("producer_seq", 14, T.TYPE_UINT64),
+    ],
+    "ReplicateRequest": [
+        # epoch fencing: a stale leader's stream is rejected by epoch
+        ("epoch", 3, T.TYPE_UINT64),
+        # where clients should send traffic while this leader holds
+        # the epoch (followers persist it and serve it as the hint)
+        ("leader_hint", 4, T.TYPE_STRING),
+    ],
+    "ReplicateResponse": [
+        ("epoch", 2, T.TYPE_UINT64),
+        # fenced=True: the receiver holds a HIGHER epoch; the sender
+        # must stop acting as leader (split-brain guard)
+        ("fenced", 3, T.TYPE_BOOL),
+        ("leader_hint", 4, T.TYPE_STRING),
+    ],
+    "ReplicaInfoResponse": [
+        ("epoch", 4, T.TYPE_UINT64),
+        ("leader_hint", 5, T.TYPE_STRING),
+    ],
+}
+
+# new top-level messages: name -> [(field, number, type)]
+NEW_MESSAGES = {
+    "PromoteRequest": [
+        ("epoch", 1, T.TYPE_UINT64),
+        ("leader_addr", 2, T.TYPE_STRING),
+        ("promoted_by", 3, T.TYPE_STRING),
+    ],
+    "PromoteResponse": [
+        ("ok", 1, T.TYPE_BOOL),
+        ("epoch", 2, T.TYPE_UINT64),
+        ("applied_seq", 3, T.TYPE_UINT64),
+        ("node_id", 4, T.TYPE_STRING),
+    ],
+}
+
+# service -> [(method, input message, output message)]
+NEW_METHODS = {
+    "StoreReplica": [
+        ("Promote", "PromoteRequest", "PromoteResponse"),
+    ],
+}
+
+PKG = ".hstream.tpu."
+
+
+def _load_blob() -> bytes:
+    sys.path.insert(0, REPO)
+    from hstream_tpu.proto import api_pb2
+
+    return api_pb2.DESCRIPTOR.serialized_pb
+
+
+def patch(blob: bytes) -> tuple[bytes, int]:
+    """Apply the edit tables; returns (new blob, number of edits)."""
+    fdp = dpb.FileDescriptorProto()
+    fdp.ParseFromString(blob)
+    msgs = {m.name: m for m in fdp.message_type}
+    edits = 0
+
+    def add_field(msg, name, number, ftype):
+        nonlocal edits
+        if any(f.name == name for f in msg.field):
+            return
+        f = msg.field.add()
+        f.name = name
+        f.number = number
+        f.type = ftype
+        f.label = T.LABEL_OPTIONAL
+        parts = name.split("_")
+        f.json_name = parts[0] + "".join(p.title() for p in parts[1:])
+        edits += 1
+
+    for mname, fields in NEW_FIELDS.items():
+        for name, number, ftype in fields:
+            add_field(msgs[mname], name, number, ftype)
+    for mname, fields in NEW_MESSAGES.items():
+        if mname in msgs:
+            msg = msgs[mname]
+        else:
+            msg = fdp.message_type.add()
+            msg.name = mname
+            msgs[mname] = msg
+            edits += 1
+        for name, number, ftype in fields:
+            add_field(msg, name, number, ftype)
+    for sname, methods in NEW_METHODS.items():
+        svc = next(s for s in fdp.service if s.name == sname)
+        for name, in_m, out_m in methods:
+            if any(m.name == name for m in svc.method):
+                continue
+            m = svc.method.add()
+            m.name = name
+            m.input_type = PKG + in_m
+            m.output_type = PKG + out_m
+            edits += 1
+    return fdp.SerializeToString(), edits
+
+
+TEMPLATE = '''\
+# -*- coding: utf-8 -*-
+# Generated by the protocol buffer compiler.  DO NOT EDIT!
+# source: api.proto  (regenerated by tools/protopatch.py — the image
+# has no protoc; schema evolution is a descriptor-level patch)
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+from google.protobuf import empty_pb2 as google_dot_protobuf_dot_empty__pb2
+from google.protobuf import struct_pb2 as google_dot_protobuf_dot_struct__pb2
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'api_pb2', globals())
+if _descriptor._USE_C_DESCRIPTORS == False:
+
+  DESCRIPTOR._options = None
+  _HSTREAMRECORDHEADER_ATTRIBUTESENTRY._options = None
+  _HSTREAMRECORDHEADER_ATTRIBUTESENTRY._serialized_options = b'8\\001'
+  _STREAMSTATS_COUNTERSENTRY._options = None
+  _STREAMSTATS_COUNTERSENTRY._serialized_options = b'8\\001'
+  _STREAMSTATS_RATESENTRY._options = None
+  _STREAMSTATS_RATESENTRY._serialized_options = b'8\\001'
+# @@protoc_insertion_point(module_scope)
+'''
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("protopatch")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if the edit tables are not fully "
+                         "applied to the checked-in blob")
+    args = ap.parse_args(argv)
+    blob = _load_blob()
+    new_blob, edits = patch(blob)
+    if args.check:
+        if edits:
+            print(f"api_pb2.py is missing {edits} descriptor edit(s); "
+                  f"run: python -m tools.protopatch")
+            return 1
+        print("api_pb2.py descriptor is up to date")
+        return 0
+    if not edits:
+        print("no edits to apply; api_pb2.py unchanged")
+        return 0
+    with open(PB2, "w", encoding="utf-8") as f:
+        f.write(TEMPLATE.format(blob=new_blob))
+    print(f"applied {edits} descriptor edit(s) -> {PB2}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
