@@ -64,6 +64,8 @@ Instrumented sites (the registry accepts any name; these exist today):
     device.dispatch         staged lattice step dispatch
     device.fetch            deferred close/changelog D2H drain
     device.activate         device-join / fused-close kernel activation
+    device.session.dispatch session step / extract kernel dispatch
+    device.session.activate session arena activation + host migration
     task.step               query-task ingest of one read chunk
     rpc.handler             unary gRPC handler entry
 """

@@ -1054,13 +1054,9 @@ class QueryExecutor:
     def _pad_slots(self, slots: list[int]) -> np.ndarray:
         """Due-slot vector padded (with -1) to a power of two, so close
         cycles of varying width share a handful of compiled shapes
-        instead of one XLA executable per distinct due-count."""
-        p = 1
-        while p < len(slots):
-            p *= 2
-        out = np.full(p, -1, np.int32)
-        out[:len(slots)] = slots
-        return out
+        instead of one XLA executable per distinct due-count (shared
+        with the session extract path via lattice.pad_slots)."""
+        return lattice.pad_slots(slots)
 
     # contract: dispatches<=1 fetches<=1
     def _close_windows(self, starts: list[int]) -> list[dict[str, Any]]:

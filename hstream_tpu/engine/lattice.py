@@ -1158,6 +1158,401 @@ def unpack_join_matches(packed: np.ndarray, n_cols_mine: int):
             packed[5 + n_cols_mine:, :m])
 
 
+def pad_slots(slots) -> np.ndarray:
+    """Slot-index vector padded (with -1) to a power of two, so cycles
+    of varying width share a handful of compiled shapes instead of one
+    XLA executable per distinct count — shared by the fused window
+    close, batched peek, and the session extract path."""
+    p = 1
+    while p < len(slots):
+        p *= 2
+    out = np.full(p, -1, np.int32)
+    out[:len(slots)] = slots
+    return out
+
+
+# ---- session lattice kernels -------------------------------------------------
+#
+# The TPU restatement of the reference's SessionStore + merge-on-overlap
+# loop (SessionWindowedStream.hs:84-118, hstream-processing SessionWindows):
+# open sessions live in a device-resident ARENA of (key code, t0, t1,
+# acc planes) kept sorted by (code, t0), and each micro-batch is ONE
+# fused dispatch that
+#   1. sorts (arena entries ∪ batch records) by (code, start) with one
+#      stable `lax.sort` — a record is a degenerate session [ts, ts];
+#   2. runs a SEGMENTED SCAN over the sorted sequence: a chain breaks at
+#      a key change or where start > running-max(end) + gap. Because
+#      merging only ever grows intervals, the sorted sweep's chains are
+#      exactly the fixpoint of the reference's sequential merge-on-
+#      overlap (interval clustering is confluent), and every accumulator
+#      is a commutative monoid, so folding a whole chain is exact;
+#   3. scatters each chain into a fresh compacted arena slot (merge and
+#      compaction are the same scatter) — per-record values land via
+#      the same masked monoid updates as the window lattice step.
+# Closed sessions are dropped lazily: the host passes the close cutoff
+# of its last close cycle and the kernel retires entries with
+# t1 <= cutoff before the sort (eviction rides the merge dispatch).
+# The step fetches NOTHING — the per-batch D2H cost of the session path
+# is zero; the close extract (below) is the only fetch and is dispatched
+# per close cycle, pow2-padded like the fused window close.
+#
+# The HOST keeps an exact interval mirror (code, t0, t1 — no accs) of
+# the arena, updated with the numpy twin of the same sort+scan: the
+# mirror decides late-record drops, close cycles, arena capacity, and
+# slot indices without ever syncing the device. All times are int32 ms
+# relative to a host-managed epoch (rebase delta rides the step).
+
+SESSION_SENT_CODE = JOIN_SENT_CODE  # empty/evicted arena slots
+_SESSION_NEG = -(1 << 30)           # safe "minus infinity" for the scan
+
+
+@dataclass(frozen=True)
+class SessionSpec:
+    """Static configuration the session kernels are specialized on."""
+
+    aggs: tuple[AggSpec, ...]
+    hll: HLLConfig = HLLConfig()
+    qcfg: QuantileConfig = QuantileConfig()
+
+
+def session_plane_names(spec: SessionSpec) -> list[str]:
+    """Canonical plane name per agg index: aggregates with the same
+    (kind, input) share ONE arena plane — p50 + p99 over one column
+    keep a single histogram; only the extract-time estimate differs.
+    The first such agg owns the plane; kernels skip non-owners so
+    additive planes never double-count."""
+    seen: dict = {}
+    out: list[str] = []
+    for i, agg in enumerate(spec.aggs):
+        key = (agg.kind, agg.input)
+        name = seen.get(key)
+        if name is None:
+            name = _plane_name(i, agg)
+            seen[key] = name
+        out.append(name)
+    return out
+
+
+def session_plane_np(spec: SessionSpec, cap: int) -> dict[str, np.ndarray]:
+    """Host-side (numpy) empty arena planes — the migration path fills
+    these and device_puts once, with no device round trip."""
+    arena: dict[str, np.ndarray] = {
+        "code": np.full(cap, SESSION_SENT_CODE, np.int32),
+        "t0": np.zeros(cap, np.int32),
+        "t1": np.zeros(cap, np.int32),
+    }
+    for name, agg in zip(session_plane_names(spec), spec.aggs):
+        if name in arena:
+            continue  # aliased to an earlier same-(kind, input) agg
+        if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+            arena[name] = np.zeros(cap, np.int32)
+        elif agg.kind == AggKind.SUM:
+            arena[name] = np.zeros(cap, np.float32)
+        elif agg.kind == AggKind.AVG:
+            arena[name] = np.zeros(cap, np.float32)
+            arena[name + "_n"] = np.zeros(cap, np.int32)
+        elif agg.kind == AggKind.MIN:
+            arena[name] = np.full(cap, np.inf, np.float32)
+        elif agg.kind == AggKind.MAX:
+            arena[name] = np.full(cap, -np.inf, np.float32)
+        elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+            arena[name] = np.zeros((cap, spec.hll.m), np.int8)
+        elif agg.kind == AggKind.APPROX_QUANTILE:
+            arena[name] = np.zeros((cap, spec.qcfg.n_bins), np.int32)
+        else:
+            raise NotImplementedError(f"session agg {agg.kind}")
+    return arena
+
+
+def grow_session_arena(spec: SessionSpec, arena: dict, new_cap: int
+                       ) -> dict[str, jnp.ndarray]:
+    """Pad every arena plane to new_cap (identity values in the tail)."""
+    fresh = init_session_arena(spec, new_cap)
+    return {k: fresh[k].at[:v.shape[0]].set(v) for k, v in arena.items()}
+
+
+def init_session_arena(spec, cap: int) -> dict[str, jnp.ndarray]:
+    """One empty session arena on device. Derives from session_plane_np
+    so the per-AggKind dtype/identity table lives in ONE place (a
+    migration/arena mismatch would corrupt state only on the rare
+    activation-with-live-sessions path)."""
+    return {k: jnp.asarray(v)
+            for k, v in session_plane_np(spec, cap).items()}
+
+
+def _session_chain_slots(code_all, start_all, end_all, gap, cap):
+    """The shared sort + segmented-scan core: one stable lax.sort by
+    (code, start, end), then a segmented running-max-of-end scan whose
+    breaks (key change, or start past running end + gap) are the merged
+    session chains. Returns per-ORIGIN destination slots: dest[i] is the
+    compacted chain slot of concat-domain entry i (cap = dropped)."""
+    m = code_all.shape[0]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    scode, sstart, send, sidx = jax.lax.sort(
+        (code_all, start_all, end_all, idx), num_keys=3)
+    newrun = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), scode[1:] != scode[:-1]])
+
+    def comb(a, b):
+        fa, ma = a
+        fb, mb = b
+        return fa | fb, jnp.where(fb, mb, jnp.maximum(ma, mb))
+
+    _, runmax = jax.lax.associative_scan(comb, (newrun, send))
+    prev_end = jnp.concatenate(
+        [jnp.full((1,), _SESSION_NEG, jnp.int32), runmax[:-1]])
+    brk = newrun | (sstart > prev_end + gap)
+    cid = jnp.cumsum(brk.astype(jnp.int32)) - 1
+    live = scode < SESSION_SENT_CODE  # sentinels sort last
+    slot = jnp.where(live, cid, cap)
+    # scatter destinations back to the concat (origin) domain
+    return jnp.zeros((m,), jnp.int32).at[sidx].set(slot)
+
+
+@functools.lru_cache(maxsize=256)
+def session_step_kernel(spec, schema, layout: ColLayout, cap: int,
+                        bcap: int):
+    """The fused per-micro-batch session kernel — ONE dispatch, ZERO
+    fetches: (arena, packed i32[3+n_cols, bcap], gap, close_cut, delta)
+    -> arena'. `close_cut` retires already-closed entries (t1 <= cut)
+    before the merge; `delta` shifts arena times on an epoch rebase.
+    Late-record drops are decided by the HOST mirror before packing, so
+    every packed record participates."""
+    agg_inputs, null_keys = compile_agg_inputs(spec, schema)
+
+    @jax.jit
+    def step(arena, packed, gap, close_cut, delta):
+        codes_b, ts_b, valid, cols = unpack_batch_device(
+            packed, layout, null_keys)
+        acode = arena["code"]
+        alive = (acode < SESSION_SENT_CODE) & (arena["t1"] > close_cut)
+        acode = jnp.where(alive, acode, SESSION_SENT_CODE)
+        at0 = jnp.where(alive, arena["t0"] - delta, 0)
+        at1 = jnp.where(alive, arena["t1"] - delta, 0)
+        bcode = jnp.where(valid, codes_b, SESSION_SENT_CODE)
+        dest = _session_chain_slots(
+            jnp.concatenate([acode, bcode]),
+            jnp.concatenate([at0, ts_b]),
+            jnp.concatenate([at1, ts_b]), gap, cap)
+        da, db = dest[:cap], dest[cap:]
+
+        out = {
+            "code": jnp.full((cap,), SESSION_SENT_CODE, jnp.int32)
+            .at[da].min(acode, mode="drop")
+            .at[db].min(bcode, mode="drop"),
+            "t0": jnp.full((cap,), np.iinfo(np.int32).max, jnp.int32)
+            .at[da].min(at0, mode="drop")
+            .at[db].min(ts_b, mode="drop"),
+            "t1": jnp.full((cap,), _SESSION_NEG, jnp.int32)
+            .at[da].max(at1, mode="drop")
+            .at[db].max(ts_b, mode="drop"),
+        }
+        empty = out["code"] >= SESSION_SENT_CODE
+        out["t0"] = jnp.where(empty, 0, out["t0"])
+        out["t1"] = jnp.where(empty, 0, out["t1"])
+
+        done: set[str] = set()
+        for i, (name, agg) in enumerate(zip(session_plane_names(spec),
+                                            spec.aggs)):
+            if name in done:
+                continue  # aliased plane: the owner already updated it
+            done.add(name)
+            vfn, null_key = agg_inputs[i]
+            if agg.kind == AggKind.COUNT_ALL:
+                out[name] = jnp.zeros((cap,), jnp.int32) \
+                    .at[da].add(arena[name], mode="drop") \
+                    .at[db].add(valid.astype(jnp.int32), mode="drop")
+                continue
+            v = vfn(cols)
+            input_ok = valid
+            if null_key is not None:
+                input_ok = input_ok & ~cols[null_key]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                input_ok = input_ok & jnp.isfinite(v)
+            vf = v.astype(jnp.float32)
+            if agg.kind == AggKind.COUNT:
+                out[name] = jnp.zeros((cap,), jnp.int32) \
+                    .at[da].add(arena[name], mode="drop") \
+                    .at[db].add(input_ok.astype(jnp.int32), mode="drop")
+            elif agg.kind == AggKind.SUM:
+                out[name] = jnp.zeros((cap,), jnp.float32) \
+                    .at[da].add(arena[name], mode="drop") \
+                    .at[db].add(jnp.where(input_ok, vf, 0.0), mode="drop")
+            elif agg.kind == AggKind.AVG:
+                out[name] = jnp.zeros((cap,), jnp.float32) \
+                    .at[da].add(arena[name], mode="drop") \
+                    .at[db].add(jnp.where(input_ok, vf, 0.0), mode="drop")
+                out[name + "_n"] = jnp.zeros((cap,), jnp.int32) \
+                    .at[da].add(arena[name + "_n"], mode="drop") \
+                    .at[db].add(input_ok.astype(jnp.int32), mode="drop")
+            elif agg.kind == AggKind.MIN:
+                out[name] = jnp.full((cap,), POS_INF, jnp.float32) \
+                    .at[da].min(arena[name], mode="drop") \
+                    .at[db].min(jnp.where(input_ok, vf, POS_INF),
+                                mode="drop")
+            elif agg.kind == AggKind.MAX:
+                out[name] = jnp.full((cap,), NEG_INF, jnp.float32) \
+                    .at[da].max(arena[name], mode="drop") \
+                    .at[db].max(jnp.where(input_ok, vf, NEG_INF),
+                                mode="drop")
+            elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+                reg, rank = hll_update_indices(vf, spec.hll)
+                out[name] = jnp.zeros((cap, spec.hll.m), jnp.int8) \
+                    .at[da].max(arena[name], mode="drop") \
+                    .at[db, reg].max(jnp.where(input_ok, rank, 0),
+                                     mode="drop")
+            elif agg.kind == AggKind.APPROX_QUANTILE:
+                b = quantile_bin(vf, spec.qcfg)
+                out[name] = jnp.zeros((cap, spec.qcfg.n_bins), jnp.int32) \
+                    .at[da].add(arena[name], mode="drop") \
+                    .at[db, b].add(input_ok.astype(jnp.int32),
+                                   mode="drop")
+            else:
+                raise NotImplementedError(f"session agg {agg.kind}")
+        return out
+
+    return step
+
+
+@functools.lru_cache(maxsize=256)
+def session_merge_kernel(spec, cap: int, scap: int):
+    """The segment-mode session kernel: the host pre-reduces the batch's
+    rows into per-SEGMENT plane contributions (the reference host path's
+    vectorized reduceat/add.at machinery — segments are the batch's own
+    gap-chains, so the pre-merge is exact), and this kernel merges the
+    segment arena into the open-session arena: ONE dispatch running the
+    same sort + segmented scan over cap + scap entries, then row-level
+    monoid scatters per plane. Chosen on backends where per-record
+    device scatters lose to the host's vectorized reduction (CPU); the
+    record-mode step (session_step_kernel) stays the wire-frugal
+    default for real accelerators.
+
+    (arena, seg {same planes, [scap]}, gap, close_cut, delta) -> arena'
+    """
+
+    @jax.jit
+    def merge(arena, seg, gap, close_cut, delta):
+        acode = arena["code"]
+        alive = (acode < SESSION_SENT_CODE) & (arena["t1"] > close_cut)
+        acode = jnp.where(alive, acode, SESSION_SENT_CODE)
+        at0 = jnp.where(alive, arena["t0"] - delta, 0)
+        at1 = jnp.where(alive, arena["t1"] - delta, 0)
+        dest = _session_chain_slots(
+            jnp.concatenate([acode, seg["code"]]),
+            jnp.concatenate([at0, seg["t0"]]),
+            jnp.concatenate([at1, seg["t1"]]), gap, cap)
+        da, db = dest[:cap], dest[cap:]
+        out = {
+            "code": jnp.full((cap,), SESSION_SENT_CODE, jnp.int32)
+            .at[da].min(acode, mode="drop")
+            .at[db].min(seg["code"], mode="drop"),
+            "t0": jnp.full((cap,), np.iinfo(np.int32).max, jnp.int32)
+            .at[da].min(at0, mode="drop")
+            .at[db].min(seg["t0"], mode="drop"),
+            "t1": jnp.full((cap,), _SESSION_NEG, jnp.int32)
+            .at[da].max(at1, mode="drop")
+            .at[db].max(seg["t1"], mode="drop"),
+        }
+        empty = out["code"] >= SESSION_SENT_CODE
+        out["t0"] = jnp.where(empty, 0, out["t0"])
+        out["t1"] = jnp.where(empty, 0, out["t1"])
+        done: set[str] = set()
+        for name, agg in zip(session_plane_names(spec), spec.aggs):
+            if name in done:
+                continue  # aliased plane: the owner already merged it
+            done.add(name)
+            names = [name] if agg.kind != AggKind.AVG \
+                else [name, name + "_n"]
+            for nm in names:
+                plane = arena[nm]
+                if agg.kind == AggKind.MIN:
+                    out[nm] = jnp.full((cap,), POS_INF, jnp.float32) \
+                        .at[da].min(plane, mode="drop") \
+                        .at[db].min(seg[nm], mode="drop")
+                elif agg.kind == AggKind.MAX:
+                    out[nm] = jnp.full((cap,), NEG_INF, jnp.float32) \
+                        .at[da].max(plane, mode="drop") \
+                        .at[db].max(seg[nm], mode="drop")
+                elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+                    out[nm] = jnp.zeros(plane.shape, plane.dtype) \
+                        .at[da].max(plane, mode="drop") \
+                        .at[db].max(seg[nm], mode="drop")
+                else:  # counts / sums / histograms: additive
+                    out[nm] = jnp.zeros(plane.shape, plane.dtype) \
+                        .at[da].add(plane, mode="drop") \
+                        .at[db].add(seg[nm], mode="drop")
+        return out
+
+    return merge
+
+
+@functools.lru_cache(maxsize=256)
+def session_extract_kernel(spec, cap: int, pcap: int):
+    """Read-only extract of the arena slots named by `slots` (pow2-
+    padded, entries < 0 extract zeros): finalize every acc plane on
+    device and pack into ONE int32 buffer [1 + n_aggs, pcap] — row 0 is
+    the slot's code (host mirror cross-check), counts/HLL rows are i32,
+    float rows f32-bitcast. One dispatch + one fetch serves a whole
+    close cycle or peek, exactly like the fused window close."""
+
+    @jax.jit
+    def extract(arena, slots):
+        ok = slots >= 0
+        at = jnp.where(ok, slots, 0)
+        rows = [jnp.where(ok, arena["code"][at], SESSION_SENT_CODE)]
+        for name, agg in zip(session_plane_names(spec), spec.aggs):
+            if agg.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+                rows.append(jnp.where(ok, arena[name][at], 0))
+                continue
+            if agg.kind == AggKind.AVG:
+                v = arena[name][at] / jnp.maximum(
+                    arena[name + "_n"][at].astype(jnp.float32), 1.0)
+            elif agg.kind == AggKind.MIN:
+                v = arena[name][at]
+                v = jnp.where(v == POS_INF, 0.0, v)
+            elif agg.kind == AggKind.MAX:
+                v = arena[name][at]
+                v = jnp.where(v == NEG_INF, 0.0, v)
+            elif agg.kind == AggKind.APPROX_COUNT_DISTINCT:
+                est = hll_estimate(arena[name][at], spec.hll)
+                rows.append(jnp.where(
+                    ok, jnp.rint(est).astype(jnp.int32), 0))
+                continue
+            elif agg.kind == AggKind.APPROX_QUANTILE:
+                hist = arena[name][at]
+                est = quantile_estimate(hist, agg.quantile or 0.5,
+                                        spec.qcfg)
+                # an all-NULL-input session has an empty histogram:
+                # the estimator's max(total, 1) target would read the
+                # LAST bin; the host reference emits 0.0
+                v = jnp.where(jnp.sum(hist, axis=-1) > 0, est, 0.0)
+            else:
+                v = arena[name][at].astype(jnp.float32)
+            rows.append(jax.lax.bitcast_convert_type(
+                jnp.where(ok, v, 0.0), jnp.int32))
+        return jnp.stack(rows)
+
+    return extract
+
+
+@functools.lru_cache(maxsize=64)
+def session_remap_kernel(cap: int, lcap: int):
+    """Code-space compaction: live arena codes gather a dense, ORDER-
+    PRESERVING new code through the pow2-padded LUT (codes >= lcap —
+    including the sentinel — pass through), so the arena stays (code,
+    t0)-sorted across the remap. One dispatch, no fetch."""
+
+    @jax.jit
+    def remap(arena, lut):
+        code = arena["code"]
+        out = dict(arena)
+        out["code"] = jnp.where(code < lcap,
+                                lut[jnp.clip(code, 0, lcap - 1)], code)
+        return out
+
+    return remap
+
+
 @jax.jit
 def rebase(state, delta):
     """Shift device-relative time by -delta (host re-anchored the epoch)."""

@@ -5,13 +5,31 @@ belongs to session [ts, ts]; sessions of the same key merge when their
 gap-extended intervals overlap (ts within `gap` of the session edge);
 a session closes when the watermark passes end + gap + grace.
 
-Merge-on-overlap is inherently sequential per key, so the design follows
-SURVEY §7: per-batch segmentation is vectorized (lexsort by (key, ts) +
-gap-break detection + reduceat segment reduction), then the few resulting
-segment aggregates merge into per-key session state on the host. All
-accumulators are monoids, so segment/session merges are exact. Device
-offload of the segmentation is a later optimization — per-batch work is
-O(B log B) numpy, and segment counts are tiny compared to record counts.
+Merge-on-overlap LOOKS inherently sequential per key, but session merge
+is an associative monoid fold over ts-ordered segments (Dataflow-model
+session semantics), so the hot path now runs as lattice kernels
+(engine.lattice "session lattice kernels"): open sessions live in a
+device-resident arena sorted by (key code, t0), and each micro-batch is
+ONE fused dispatch — sort (arena ∪ batch) by (code, ts) with a stable
+`lax.sort`, segmented-scan the chain boundaries (gap > timeout ⇒ new
+session), scatter each chain into a compacted arena slot with monoid
+acc merges. The step fetches nothing; closed sessions come back through
+the pow2-padded extract path (one dispatch + one fetch per close cycle)
+and emit as a ColumnarEmit end-to-end.
+
+The HOST path below is retained in full as the equivalence reference
+(`use_device_sessions=False`): per-batch segmentation vectorized in
+numpy, per-segment accumulators via reduceat, segment merges into
+per-key Python session state. The device path keeps an exact host-side
+interval MIRROR (code, t0, t1 — no accumulators) of the arena, updated
+with the numpy twin of the kernel's sort+scan: the mirror decides
+late-record drops (the order-dependent part of the reference
+semantics), close cycles, capacity, and slot indices with zero device
+syncs. The executor degrades per-executor to the host path — PR 8
+style, counted in `device_fallbacks` — on kernel failure, on
+pathological overlap chains (one session swallowing more than
+`chain_merge_limit` open sessions in a batch), and never activates for
+host-only aggregate configs (TOPK lists, EMIT CHANGES sessions).
 """
 
 from __future__ import annotations
@@ -22,13 +40,36 @@ from typing import Any, Mapping, Sequence
 
 import numpy as np
 
+from hstream_tpu.common.columnar import ColumnarEmit, extend_rows
 from hstream_tpu.common.errors import SQLCodegenError
+from hstream_tpu.common.faultinject import FAULTS
+from hstream_tpu.common.logger import get_logger
 from hstream_tpu.engine.executor import QueryExecutor
-from hstream_tpu.engine.expr import eval_host
+from hstream_tpu.engine.expr import (
+    columns_of,
+    compile_device,
+    encode_strings,
+    eval_host,
+    eval_host_vec,
+)
 from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec
 from hstream_tpu.engine.sketches import HLLConfig, QuantileConfig
-from hstream_tpu.engine.types import Schema, canon_key
+from hstream_tpu.engine.types import (
+    ColumnType,
+    HostBatch,
+    Schema,
+    StringDictionary,
+    canon_key,
+    round_up_pow2,
+)
 from hstream_tpu.engine.window import SessionWindow
+
+log = get_logger("session")
+
+# sentinel return of the device ingest helpers: the executor degraded
+# mid-plan (state already pulled back to host); the caller reruns the
+# batch through the host path
+_DEGRADED = object()
 
 
 # ---- numpy sketch helpers (host-side finalize) -----------------------------
@@ -102,6 +143,55 @@ def quantile_estimate_np(hist: np.ndarray, q: float,
     return np.where((idx == 0) | (total == 0), 0.0, est)
 
 
+# ---- interval chain merge (numpy twin of the device kernel) -----------------
+
+def merge_chains_np(code: np.ndarray, t0: np.ndarray, t1: np.ndarray,
+                    gap: int, n_first: int = 0
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Chain-merge intervals by gap-overlap: sort by (code, t0, t1),
+    break a chain at a code change or where t0 exceeds the running max
+    end + gap — the exact fixpoint of sequential merge-on-overlap
+    (interval clustering is confluent: merging only grows intervals).
+    This is the numpy twin of lattice._session_chain_slots, so the
+    returned chains are, in order, exactly the device arena's slots.
+
+    Returns (code, t0, t1) per chain plus the max number of the FIRST
+    `n_first` input entries (the open-session mirror) landing in one
+    chain — the pathological-overlap-chain detector."""
+    n = len(code)
+    if n == 0:
+        e = np.empty(0, np.int64)
+        return e, e.copy(), e.copy(), 0
+    order = np.lexsort((t1, t0, code))
+    c = code[order].astype(np.int64)
+    a = t0[order].astype(np.int64)
+    b = t1[order].astype(np.int64)
+    newrun = np.empty(n, np.bool_)
+    newrun[0] = True
+    newrun[1:] = c[1:] != c[:-1]
+    # segmented running max of end via one accumulate: offset each code
+    # run into its own disjoint value band (span bounded by the int32
+    # relative-time guard, codes < 2^22, so the product fits int64)
+    base = int(b.min())
+    span = int(b.max()) - base + int(gap) + 2
+    runmax = np.maximum.accumulate(c * span + (b - base)) - c * span + base
+    prev = np.empty(n, np.int64)
+    prev[0] = base - gap - 1
+    prev[1:] = runmax[:-1]
+    brk = newrun | (a > prev + gap)
+    starts = np.nonzero(brk)[0]
+    mcode = c[starts]
+    mt0 = a[starts]
+    mt1 = np.maximum.reduceat(b, starts)
+    fanin = 0
+    if n_first:
+        cid = np.cumsum(brk) - 1
+        first = cid[order < n_first]
+        if len(first):
+            fanin = int(np.bincount(first).max())
+    return mcode, mt0, mt1, fanin
+
+
 # ---- session state ---------------------------------------------------------
 
 @dataclass
@@ -156,11 +246,27 @@ def _acc_merge(agg: AggSpec, a, b):
 
 
 class SessionExecutor:
-    """Windowed-by-session grouped aggregation (host merge engine).
+    """Windowed-by-session grouped aggregation.
 
     API-compatible with QueryExecutor: process(rows, ts_ms) -> emitted
     rows; emitted rows carry winStart/winEnd = [session start,
-    session end + gap) like the reference's session serde."""
+    session end + gap) like the reference's session serde. The hot path
+    runs on device (module docstring); the host merge engine below is
+    the retained equivalence reference and degrade target."""
+
+    # tasks.py columnar feed capability: process_columnar takes
+    # (ts, named numpy columns, nulls) — the join's _plain_columns shape
+    supports_columnar_sessions = True
+
+    # aggregate kinds the device arena carries; TOPK value lists stay
+    # host-only (no fixed-width monoid plane worth it for sessions)
+    _DEVICE_AGG_KINDS = frozenset({
+        AggKind.COUNT_ALL, AggKind.COUNT, AggKind.SUM, AggKind.AVG,
+        AggKind.MIN, AggKind.MAX, AggKind.APPROX_COUNT_DISTINCT,
+        AggKind.APPROX_QUANTILE,
+    })
+
+    REBASE_THRESHOLD = 1 << 30  # re-anchor epoch past this relative ms
 
     def __init__(self, node: AggregateNode, schema: Schema, *,
                  emit_changes: bool = False,
@@ -180,11 +286,53 @@ class SessionExecutor:
         # key tuple -> list[_Session], kept sorted by start
         self.sessions: dict[tuple, list[_Session]] = {}
         self._filter = QueryExecutor._extract_filter(self)  # same chain walk
-        # batch key-encoding caches (rebuildable; not snapshot state)
+        # batch key-encoding caches (rebuildable; not snapshot state) —
+        # in device mode the codes ARE the arena's sort keys, so the
+        # cache bound compacts (order-preserving remap kernel) instead
+        # of clearing
         self._code_of: dict[tuple, int] = {}   # canon key -> code
         self._code_rev: list[tuple] = []       # code -> canon key
         self._raw_memo: dict[Any, int] = {}    # raw value(s) -> code
         self._input_cache: dict = {}           # per-batch input columns
+        # device session path (engine.lattice session kernels);
+        # use_device_sessions=False pins the host reference engine
+        self.use_device_sessions = True
+        self._dev: dict | None = None
+        self._device_refusal: str | None = None   # host-only config
+        # None = auto (backend-dependent); "record" | "segment" force a
+        # kernel mode — see _plan_device
+        self.device_session_mode: str | None = None
+        # Deferred close decode (device mode): closing sessions keeps
+        # the packed extract as a device value; drain_closed() fetches
+        # every pending cycle in ONE stacked transfer per buffer shape
+        # (the PR 5 deferred-close idiom — on a tunneled link each
+        # fetch is a full round trip)
+        self.defer_close_decode = False
+        self._pending_closes: list[tuple] = []
+        # one batch chain may merge at most this many OPEN sessions;
+        # deeper chains are the pathological case the mirror detects
+        # and routes to the host reference path (degrade, not die)
+        self.chain_merge_limit = 32
+        # device activations/dispatches that failed and degraded this
+        # executor to the host path; the query task mirrors deltas into
+        # the device_path_fallbacks counter
+        self.device_fallbacks = 0
+        self.epoch: int | None = None   # device relative-time anchor
+        self._closed_wm: int = -1       # wm of the last close cycle
+        # ingest-path dispatch accounting: the session device contract
+        # is ONE step dispatch and ZERO fetches per micro-batch, plus
+        # one extract dispatch + one fetch per close cycle — bench and
+        # tests assert on these
+        self.session_stats = {
+            "batches": 0, "step_dispatches": 0, "close_cycles": 0,
+            "close_dispatches": 0, "close_fetches": 0,
+            "peek_dispatches": 0, "remap_dispatches": 0, "grows": 0,
+        }
+        self.dicts: dict[str, StringDictionary] = {
+            name: StringDictionary() for name, t in schema.fields
+            if t == ColumnType.STRING
+        }
+        self._code_cols_cache: tuple[int, list[np.ndarray]] = (-1, [])
 
     # QueryExecutor._extract_filter reads self.node only.
 
@@ -252,6 +400,13 @@ class SessionExecutor:
                 ts_ms: Sequence[int]) -> list[dict[str, Any]]:
         if not rows:
             return []
+        if self._device_ready():
+            out = self._process_rows_device(rows, ts_ms)
+            if out is not _DEGRADED:
+                return out
+            # degraded mid-plan: device state was pulled back into
+            # self.sessions untouched by this batch — fall through to
+            # the host engine below
         gap = self.window.gap_ms
         grace = self.window.grace_ms
         touched: set[tuple] = set()
@@ -302,15 +457,15 @@ class SessionExecutor:
         if new_wm > self.watermark:
             self.watermark = new_wm
 
-        out: list[dict[str, Any]] = []
+        out = None
         if self.emit_changes:
-            for key in touched:
-                for s in self.sessions.get(key, []):
-                    r = self._emit_row(key, s)
-                    if r is not None:
-                        out.append(r)
-        out.extend(self.close_due_sessions())
-        return out
+            pairs = [(key, s) for key in touched
+                     for s in self.sessions.get(key, [])]
+            out = extend_rows(out, self._emit_cols_batch(pairs))
+        # a lone columnar batch (changes or closes) stays columnar all
+        # the way to the caller (extend_rows, the PR 5 drain threading)
+        out = extend_rows(out, self.close_due_sessions())
+        return out if out is not None else []
 
     def _row_passes(self, row: Mapping[str, Any]) -> bool:
         try:
@@ -324,14 +479,26 @@ class SessionExecutor:
     # growing without limit after its sessions closed
     _KEY_CACHE_MAX = 1 << 18
 
+    def _bound_key_cache(self) -> None:
+        """Cache-bound enforcement: host mode drops the caches wholesale
+        (codes only matter within one batch there); device mode must
+        keep codes of keys with LIVE arena sessions stable, so it
+        compacts through the order-preserving remap kernel instead."""
+        if len(self._code_of) <= self._KEY_CACHE_MAX:
+            return
+        if self._dev is not None:
+            self._compact_codes_device()
+        else:
+            self._code_of = {}
+            self._code_rev = []
+            self._raw_memo = {}
+            self._code_cols_cache = (-1, [])
+
     def _key_codes(self, rows) -> tuple[np.ndarray, list]:
         """Dense int codes per row's group key. Codes persist across
         batches (encoding cache only — not part of snapshot state);
         raw-value memoization keeps the per-row cost to one dict hit."""
-        if len(self._code_of) > self._KEY_CACHE_MAX:
-            self._code_of = {}
-            self._code_rev = []
-            self._raw_memo = {}
+        self._bound_key_cache()
         out = np.empty(len(rows), np.int64)
         rev = self._code_rev
         if len(self.group_cols) == 1:
@@ -551,6 +718,8 @@ class SessionExecutor:
         # The reference never eagerly deletes session state
         # (SessionWindowedStream.hs:84-118); closing one gap-width later
         # preserves its merge-on-overlap semantics while still emitting.
+        if self._dev is not None:
+            return self._close_due_device()
         gap, grace = self.window.gap_ms, self.window.grace_ms
         pairs: list[tuple[tuple, _Session]] = []
         for key, sess_list in list(self.sessions.items()):
@@ -562,33 +731,117 @@ class SessionExecutor:
                 sess_list.remove(s)
             if not sess_list:
                 del self.sessions[key]
-        return self._emit_rows_batch(pairs)
+        return self._emit_cols_batch(pairs)
 
-    def _emit_rows_batch(self, pairs: list) -> list[dict[str, Any]]:
-        """Emit many sessions at once: sketch finalization (quantile
-        cdf + DDSketch bin edge, HLL estimate) runs vectorized over the
-        whole close set instead of ~10 numpy calls per row."""
+    def _emit_cols_batch(self, pairs: list
+                         ) -> "ColumnarEmit | list[dict[str, Any]]":
+        """Columnar emission of many host sessions at once: every
+        aggregate finalizes as one vectorized column (sketch estimates
+        batched over the whole set), HAVING/projections evaluate
+        columnwise, and the result stays a ColumnarEmit until the wire —
+        sessions were the last emitter materializing per-row dicts.
+        The per-row reference is _emit_row (equivalence tests and the
+        host-only-op fallback)."""
         if not pairs:
             return []
-        vec: dict[str, np.ndarray] = {}
+        n = len(pairs)
+        cols: dict[str, Any] = {}
+        for gi, name in enumerate(self.group_cols):
+            arr = np.empty(n, object)
+            arr[:] = [key[gi] for key, _ in pairs]
+            cols[name] = arr
         for a in self.aggs:
-            if a.kind == AggKind.APPROX_QUANTILE:
-                hist = np.stack([s.accs[a.out_name] for _, s in pairs])
-                vec[a.out_name] = quantile_estimate_np(
-                    hist, a.quantile or 0.5, self.qcfg)
+            accs = [s.accs[a.out_name] for _, s in pairs]
+            if a.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+                cols[a.out_name] = np.asarray(accs, np.int64)
+            elif a.kind == AggKind.SUM:
+                cols[a.out_name] = np.asarray(accs, np.float64)
+            elif a.kind == AggKind.AVG:
+                s_ = np.asarray([x[0] for x in accs], np.float64)
+                c_ = np.asarray([x[1] for x in accs], np.int64)
+                cols[a.out_name] = s_ / np.maximum(c_, 1)
+            elif a.kind == AggKind.MIN:
+                v = np.asarray(accs, np.float64)
+                cols[a.out_name] = np.where(v == np.inf, 0.0, v)
+            elif a.kind == AggKind.MAX:
+                v = np.asarray(accs, np.float64)
+                cols[a.out_name] = np.where(v == -np.inf, 0.0, v)
             elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
-                regs = np.stack([s.accs[a.out_name] for _, s in pairs])
-                vec[a.out_name] = np.rint(
+                regs = np.stack(accs)
+                cols[a.out_name] = np.rint(
                     hll_estimate_np(regs, self.hll)).astype(np.int64)
-        rows = []
-        for i, (key, s) in enumerate(pairs):
-            overrides = {
-                name: (float(v[i]) if v.dtype.kind == "f" else int(v[i]))
-                for name, v in vec.items()}
-            r = self._emit_row(key, s, overrides or None)
-            if r is not None:
-                rows.append(r)
-        return rows
+            elif a.kind == AggKind.APPROX_QUANTILE:
+                hist = np.stack(accs)
+                cols[a.out_name] = quantile_estimate_np(
+                    hist, a.quantile or 0.5, self.qcfg).astype(np.float64)
+            elif a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT):
+                arr = np.empty(n, object)
+                arr[:] = [list(acc) for acc in accs]
+                cols[a.out_name] = arr
+            else:
+                raise SQLCodegenError(f"session agg {a.kind} unsupported")
+        cols["winStart"] = np.asarray([s.start for _, s in pairs],
+                                      np.int64)
+        cols["winEnd"] = np.asarray(
+            [s.end + self.window.gap_ms for _, s in pairs], np.int64)
+        return self._postprocess_session_cols(cols, n)
+
+    def _postprocess_session_cols(self, cols: dict[str, Any], n: int
+                                  ) -> "ColumnarEmit | list[dict[str, Any]]":
+        """HAVING + SELECT projections over a columnar session batch;
+        any host-only op (or NULL-driven eval error) falls back to the
+        per-row path whose drop semantics match _emit_row exactly."""
+        if self.node.having is not None:
+            try:
+                keep = np.broadcast_to(
+                    np.asarray(eval_host_vec(self.node.having, cols),
+                               np.bool_), (n,))
+            except Exception:  # noqa: BLE001 — host-only op / NULLs
+                return self._postprocess_session_rows(
+                    ColumnarEmit(cols, n))
+            if not keep.all():
+                cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
+                n = int(keep.sum())
+                if n == 0:
+                    return []
+        if self.node.post_projections:
+            try:
+                projected: dict[str, Any] = {}
+                for name, expr in self.node.post_projections:
+                    v = eval_host_vec(expr, cols)
+                    projected[name] = np.broadcast_to(
+                        np.asarray(v), (n,)) if np.ndim(v) == 0 \
+                        else np.asarray(v)
+                for meta in ("winStart", "winEnd"):
+                    projected[meta] = cols[meta]
+                cols = projected
+            except Exception:  # noqa: BLE001
+                return self._postprocess_session_rows(
+                    ColumnarEmit(cols, n))
+        return ColumnarEmit(cols, n)
+
+    def _postprocess_session_rows(self, rows) -> list[dict[str, Any]]:
+        """Per-row HAVING/projection fallback — the same drop rules as
+        _emit_row (a HAVING eval error drops the row; projection errors
+        propagate, as they always did)."""
+        out = []
+        for row in rows:
+            if self.node.having is not None:
+                try:
+                    if not eval_host(self.node.having, row):
+                        continue
+                except (TypeError, KeyError):
+                    continue
+            if self.node.post_projections:
+                proj = {}
+                for name, expr in self.node.post_projections:
+                    proj[name] = eval_host(expr, row)
+                for meta in ("winStart", "winEnd"):
+                    proj[meta] = row[meta]
+                out.append(proj)
+            else:
+                out.append(row)
+        return out
 
     def _finalize(self, agg: AggSpec, acc):
         if agg.kind == AggKind.AVG:
@@ -636,10 +889,1156 @@ class SessionExecutor:
         return row
 
     def peek(self) -> list[dict[str, Any]]:
-        rows = []
+        """Open-session rows (pull queries / view peeks), columnar on
+        both engines: ONE read-only extract dispatch + ONE fetch covers
+        every open session on device; the host engine finalizes every
+        session as one vectorized column batch."""
+        if self._dev is not None:
+            return self._peek_device()
+        pairs = [(key, s) for key, sess_list in self.sessions.items()
+                 for s in sess_list]
+        return self._emit_cols_batch(pairs)
+
+    # ---- device session path (engine.lattice session kernels) --------------
+    #
+    # Open sessions live in a device arena sorted by (code, t0); each
+    # micro-batch is ONE fused sort + segmented-scan merge dispatch and
+    # ZERO fetches; close cycles and peeks are one pow2-padded extract
+    # dispatch + one fetch each. The host keeps an exact interval
+    # mirror (merge_chains_np — the numpy twin of the kernel's scan)
+    # that decides late-record drops, close sets, capacity, and slot
+    # indices with no device sync. The host engine above is the
+    # equivalence reference and the degrade target (PR 8 pattern).
+
+    def _device_ready(self) -> bool:
+        if self._dev is not None:
+            return True
+        if not self.use_device_sessions \
+                or self._device_refusal is not None:
+            return False
+        plan = self._plan_device()
+        if plan is None:
+            return False  # host-only config: a refusal, not a failure
+        try:
+            if FAULTS.active:  # chaos: provoke an activation failure
+                FAULTS.point("device.session.activate")
+            self._activate_device(plan)
+            return True
+        except Exception as e:  # noqa: BLE001 — an activation failure
+            # (kernel build, migration, device OOM, injected fault)
+            # degrades to the retained host reference path instead of
+            # killing the query; results are identical, only slower
+            log.warning(
+                "device session activation failed (%s: %s); staying on "
+                "the host reference path", type(e).__name__, e)
+            self._dev = None
+            self.use_device_sessions = False
+            self.device_fallbacks += 1
+            return False
+
+    def _plan_device(self) -> dict | None:
+        """Static plan for the device path, or None (with the refusal
+        recorded) for host-only configs: EMIT CHANGES sessions (emit
+        per touched key, a per-batch extract the host path serves
+        better), TOPK list aggregates, and — in record mode — aggregate
+        inputs the device expression compiler cannot express.
+
+        Mode selection: "record" packs raw records and runs the fully
+        fused sort+scan+scatter step — the wire-frugal shape for real
+        accelerators where per-record scatters are cheap and H2D bytes
+        are not. "segment" pre-reduces rows into per-segment plane
+        contributions on the host (the reference path's vectorized
+        reduceat/add.at) and merges arenas on device — the shape for
+        the CPU backend, where XLA per-record scatters lose to numpy's
+        vectorized reduction. `device_session_mode` overrides."""
+        import jax
+
+        from hstream_tpu.engine import lattice
+
+        if self.emit_changes:
+            self._device_refusal = "EMIT CHANGES sessions emit per " \
+                "touched key; host path retained"
+            return None
+        if 2 * self.window.gap_ms + self.window.grace_ms >= (1 << 30):
+            # the close rule (t1 + 2*gap + grace) must fit the int32
+            # relative-time budget alongside the span bound
+            self._device_refusal = "gap/grace span exceeds the device " \
+                "relative-time range; host path retained"
+            return None
+        for a in self.aggs:
+            if a.kind not in self._DEVICE_AGG_KINDS:
+                self._device_refusal = \
+                    f"aggregate {a.kind.value} is host-only"
+                return None
+        mode = self.device_session_mode or (
+            "segment" if jax.default_backend() == "cpu" else "record")
+        try:
+            encoded = []
+            for a in self.aggs:
+                if a.input is not None:
+                    a = AggSpec(kind=a.kind, out_name=a.out_name,
+                                input=encode_strings(a.input, self.schema,
+                                                     self.dicts),
+                                quantile=a.quantile, k=a.k)
+                encoded.append(a)
+            needed: set[str] = set()
+            for a in encoded:
+                if a.input is not None:
+                    needed |= columns_of(a.input)
+                    if mode == "record":
+                        compile_device(a.input, self.schema)  # may raise
+            layout = tuple(
+                (name, lattice.layout_tag(self.schema.type_of(name)))
+                for name in sorted(needed))
+        except Exception as e:  # noqa: BLE001 — host-only expression
+            self._device_refusal = f"device compile refused: {e}"
+            return None
+        spec = lattice.SessionSpec(aggs=tuple(encoded), hll=self.hll,
+                                   qcfg=self.qcfg)
+        null_refs = [sorted(columns_of(a.input)) for a in encoded
+                     if a.input is not None]
+        return {"spec": spec, "layout": layout, "null_refs": null_refs,
+                "mode": mode}
+
+    def _activate_device(self, plan: dict) -> None:
+        """Migrate the host session state into a fresh device arena
+        (sorted by (code, t0)) and build the interval mirror. The host
+        dict is cleared only after every plane uploaded — a failure
+        partway leaves the reference path intact to fall back on."""
+        import jax
+
+        from hstream_tpu.engine import lattice
+
+        spec = plan["spec"]
+        entries: list[tuple[int, _Session]] = []
         for key, sess_list in self.sessions.items():
+            code = self._code_of.get(key)
+            if code is None:
+                code = len(self._code_rev)
+                self._code_of[key] = code
+                self._code_rev.append(key)
             for s in sess_list:
-                r = self._emit_row(key, s)
-                if r is not None:
-                    rows.append(r)
+                entries.append((code, s))
+        n = len(entries)
+        cap = round_up_pow2(2 * max(n, 1), lo=256)
+        mir_code = np.empty(n, np.int64)
+        mir_t0 = np.empty(n, np.int64)
+        mir_t1 = np.empty(n, np.int64)
+        for i, (code, s) in enumerate(entries):
+            mir_code[i] = code
+            mir_t0[i] = s.start
+            mir_t1[i] = s.end
+        order = np.lexsort((mir_t1, mir_t0, mir_code))
+        mir_code, mir_t0, mir_t1 = (mir_code[order], mir_t0[order],
+                                    mir_t1[order])
+        epoch = int(mir_t0.min()) if n else None
+        arena_np = lattice.session_plane_np(spec, cap)
+        if n:
+            arena_np["code"][:n] = mir_code.astype(np.int32)
+            arena_np["t0"][:n] = (mir_t0 - epoch).astype(np.int32)
+            arena_np["t1"][:n] = (mir_t1 - epoch).astype(np.int32)
+            for name, a in zip(lattice.session_plane_names(spec),
+                               spec.aggs):
+                for j, (_code, s) in enumerate(
+                        (entries[o] for o in order.tolist())):
+                    acc = s.accs[a.out_name]
+                    if a.kind == AggKind.AVG:
+                        arena_np[name][j] = np.float32(acc[0])
+                        arena_np[name + "_n"][j] = acc[1]
+                    elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
+                        arena_np[name][j] = acc
+                    elif a.kind == AggKind.APPROX_QUANTILE:
+                        if int(np.max(acc, initial=0)) >= (1 << 31):
+                            raise SQLCodegenError(
+                                "session histogram count exceeds int32 "
+                                "at device activation")
+                        arena_np[name][j] = acc.astype(np.int32)
+                    else:
+                        arena_np[name][j] = np.float32(acc) \
+                            if arena_np[name].dtype == np.float32 else acc
+        self._dev = {
+            "spec": spec,
+            "layout": plan["layout"],
+            "null_refs": plan["null_refs"],
+            "mode": plan["mode"],
+            "cap": cap,
+            "arena": {k: jax.device_put(v) for k, v in arena_np.items()},
+            "mir_code": mir_code,
+            "mir_t0": mir_t0,
+            "mir_t1": mir_t1,
+            "mir_live": np.ones(n, np.bool_),
+            "bcaps": set(),
+            "scaps": set(),
+        }
+        self.epoch = epoch
+        self.sessions = {}
+
+    def _degrade_to_host(self, reason: str) -> None:
+        """Pull the device state back into the host session dict and pin
+        this executor to the reference engine — identical results, only
+        slower (counted in device_fallbacks, mirrored into the
+        device_path_fallbacks counter by the query task)."""
+        log.warning("device session path degrading to host: %s", reason)
+        # deferred closes decode lazily through _code_rev; the host-mode
+        # cache bound may rebuild that dictionary, so resolve their key
+        # columns against the CURRENT one now (same rule as the
+        # code-space compaction)
+        self._pending_closes = [
+            (codes, t0, t1, packed,
+             keys if keys is not None else
+             [arr[codes.astype(np.int64)]
+              for arr in self._code_rev_columns()])
+            for codes, t0, t1, packed, keys in self._pending_closes]
+        self.sessions = self._host_sessions_view()
+        self._dev = None
+        self.use_device_sessions = False
+        self.device_fallbacks += 1
+
+    # contract: dispatches<=0 fetches<=1
+    def _host_sessions_view(self) -> dict[tuple, list[_Session]]:
+        """Host-format view of the device arena (snapshot serialization
+        and the degrade path): ONE pytree fetch, then per-live-slot acc
+        decode into the reference accumulator formats."""
+        import jax
+
+        dev = self._dev
+        host = jax.device_get(dev["arena"])
+        spec = dev["spec"]
+        sessions: dict[tuple, list[_Session]] = {}
+        from hstream_tpu.engine import lattice
+
+        for slot in np.nonzero(dev["mir_live"])[0].tolist():
+            key = self._code_rev[int(dev["mir_code"][slot])]
+            accs: dict[str, Any] = {}
+            for name, a in zip(lattice.session_plane_names(spec),
+                               spec.aggs):
+                v = host[name][slot]
+                if a.kind in (AggKind.COUNT_ALL, AggKind.COUNT):
+                    accs[a.out_name] = int(v)
+                elif a.kind == AggKind.SUM:
+                    accs[a.out_name] = float(v)
+                elif a.kind == AggKind.AVG:
+                    accs[a.out_name] = (float(v),
+                                        int(host[name + "_n"][slot]))
+                elif a.kind in (AggKind.MIN, AggKind.MAX):
+                    accs[a.out_name] = float(v)
+                elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
+                    accs[a.out_name] = np.asarray(v, np.int8).copy()
+                elif a.kind == AggKind.APPROX_QUANTILE:
+                    accs[a.out_name] = np.asarray(v, np.int64).copy()
+            sessions.setdefault(key, []).append(_Session(
+                start=int(dev["mir_t0"][slot]),
+                end=int(dev["mir_t1"][slot]), accs=accs))
+        return sessions
+
+    def _process_rows_device(self, rows, ts_ms):
+        """Row-shaped ingest onto the device path: host filter eval,
+        key-code encode, then either schema-typed columns (record mode)
+        or per-aggregate value columns (segment mode)."""
+        ts_all = np.asarray(ts_ms, np.int64)
+        pre_max = int(ts_all.max())
+        ts = ts_all
+        if self._filter is not None:
+            keepf = np.fromiter((self._row_passes(r) for r in rows),
+                                np.bool_, len(rows))
+            if not keepf.all():
+                idx = np.nonzero(keepf)[0]
+                rows = [rows[i] for i in idx.tolist()]
+                ts = ts[idx]
+        if not rows:
+            return self._advance_and_close_device(pre_max)
+        codes, _rev = self._key_codes(rows)
+        if self._dev is None:  # the key-cache bound degraded mid-encode
+            return _DEGRADED
+        if self._dev["mode"] == "record":
+            batch = HostBatch.from_rows(self.schema, rows, ts, self.dicts)
+            feed = ("record", batch.cols, batch.nulls)
+        else:
+            self._input_cache = {}
+            feed = ("segment", [
+                None if a.input is None
+                else self._agg_input_cols(a, rows, len(rows))
+                for a in self.aggs])
+        return self._process_device(codes.astype(np.int64), ts, feed,
+                                    pre_max)
+
+    def process_columnar(self, ts_ms, cols: Mapping[str, Any],
+                         nulls: Mapping[str, np.ndarray] | None = None
+                         ) -> list[dict[str, Any]]:
+        """Columnar session ingest: int64 absolute-ms timestamps plus
+        named numpy columns (object arrays for strings — the join's
+        _plain_columns shape); a null-mask cell means the field is
+        ABSENT from that record. On the device path the batch packs
+        straight from the arrays (vectorized key encode, no row dicts);
+        until the device path activates — or after a degrade — rows
+        materialize once and take the row path, so semantics are
+        identical."""
+        n = len(ts_ms)
+        if n == 0:
+            return []
+        if self._device_ready():
+            out = self._process_columnar_device(
+                np.asarray(ts_ms, np.int64), cols, nulls)
+            if out is not _DEGRADED:
+                return out
+        return self.process(self._rows_from_cols(cols, nulls, n),
+                            [int(t) for t in np.asarray(ts_ms)])
+
+    @staticmethod
+    def _rows_from_cols(cols, nulls, n: int) -> list[dict[str, Any]]:
+        """Materialize columnar input into per-row dicts (pre-activation
+        / post-degrade fallback); null-masked cells are ABSENT fields,
+        the per-record decode shape."""
+        names = list(cols)
+        lists = [np.asarray(cols[c]).tolist() for c in names]
+        rows = [dict(zip(names, vals)) for vals in zip(*lists)] \
+            if names else [{} for _ in range(n)]
+        if nulls:
+            for cname, mask in nulls.items():
+                if cname not in cols:
+                    continue
+                for row, isnull in zip(rows, np.asarray(mask).tolist()):
+                    if isnull:
+                        del row[cname]
         return rows
+
+    def _process_columnar_device(self, ts, cols, nulls):
+        """Columnar twin of _process_rows_device: vectorized host
+        filter, memoized key encode, schema-typed device columns."""
+        n = len(ts)
+        pre_max = int(ts.max())
+        kept = None
+        if self._filter is not None:
+            try:
+                fv = eval_host_vec(self._filter, cols)
+                keep = np.broadcast_to(np.asarray(fv, np.bool_),
+                                       (n,)).copy()
+            except Exception:  # noqa: BLE001 — host-only op in WHERE:
+                # materialize rows once, run the row-shaped device path
+                return self._process_rows_device(
+                    self._rows_from_cols(cols, nulls, n),
+                    [int(t) for t in ts])
+            if nulls:
+                # SQL NULL in a WHERE operand: predicate not-true
+                for c in columns_of(self._filter):
+                    nm = nulls.get(c)
+                    if nm is not None:
+                        keep &= ~np.asarray(nm, np.bool_)
+            if not keep.all():
+                kept = np.nonzero(keep)[0]
+                ts = ts[kept]
+                if len(ts) == 0:
+                    return self._advance_and_close_device(pre_max)
+        nk = n if kept is None else len(kept)
+        codes = self._key_codes_cols(cols, nulls, kept, nk)
+        if self._dev is None:  # the key-cache bound degraded mid-encode
+            return _DEGRADED
+        if self._dev["mode"] == "record":
+            dcols, dnulls = self._typed_cols(cols, nulls, kept, nk)
+            feed = ("record", dcols, dnulls)
+        else:
+            feed = ("segment", self._agg_vals_cols(cols, nulls, kept, nk))
+        return self._process_device(codes, ts, feed, pre_max)
+
+    def _agg_vals_cols(self, cols, nulls, kept, n: int):
+        """(values f64[n], valid bool[n]) per aggregate straight from
+        raw columnar input — the columnar twin of _agg_input_cols, same
+        NULL rules (None / non-numeric / non-finite / null-masked cells
+        do not contribute)."""
+        from hstream_tpu.engine.expr import Col
+
+        out: list[tuple[np.ndarray, np.ndarray] | None] = []
+        cache: dict = {}
+        rows_cache: list | None = None
+        for a in self.aggs:
+            if a.input is None:
+                out.append(None)
+                continue
+            ck = (("col", a.input.name) if isinstance(a.input, Col)
+                  else ("expr", id(a.input)))
+            hit = cache.get(ck)
+            if hit is None:
+                if isinstance(a.input, Col):
+                    raw = cols.get(a.input.name)
+                    if raw is None:
+                        vals = np.full(n, np.nan)
+                    else:
+                        arr = np.asarray(raw)
+                        if kept is not None:
+                            arr = arr[kept]
+                        if arr.dtype.kind in "fiub":
+                            vals = arr.astype(np.float64)
+                        else:
+                            vals = np.array(
+                                [float(v) if isinstance(v, (int, float))
+                                 else np.nan for v in arr.tolist()],
+                                np.float64)
+                else:
+                    try:
+                        v = eval_host_vec(a.input, cols)
+                        vals = (np.full(n, float(v)) if np.ndim(v) == 0
+                                else np.asarray(v, np.float64))
+                        if kept is not None and len(vals) != n:
+                            vals = vals[kept]
+                    except Exception:  # noqa: BLE001 — host-only op:
+                        # per-row eval over materialized dicts, once
+                        if rows_cache is None:
+                            rows_cache = self._rows_from_cols(
+                                cols, nulls, len(np.asarray(
+                                    next(iter(cols.values())))))
+                            if kept is not None:
+                                rows_cache = [rows_cache[i]
+                                              for i in kept.tolist()]
+                        vals = np.empty(n, np.float64)
+                        for i, r in enumerate(rows_cache):
+                            try:
+                                v = eval_host(a.input, r)
+                            except (TypeError, KeyError):
+                                v = None
+                            vals[i] = (float(v) if isinstance(
+                                v, (int, float)) else np.nan)
+                # null-masked referenced cells do not contribute
+                if nulls:
+                    for c in columns_of(a.input):
+                        nm = nulls.get(c)
+                        if nm is not None:
+                            nm = np.asarray(nm, np.bool_)
+                            vals = vals.copy()
+                            vals[nm[kept] if kept is not None
+                                 else nm] = np.nan
+                hit = (vals, np.isfinite(vals))
+                cache[ck] = hit
+            out.append(hit)
+        return out
+
+    def _key_codes_cols(self, cols, nulls, kept, n: int) -> np.ndarray:
+        """Dense key codes from columnar input. Numpy-typed columns
+        factorize at C speed (np.unique per column, one dict hit per
+        DISTINCT value/combination — the _columnar_key_ids discipline);
+        object columns fall back to the memoized per-row loop.
+        Null-masked group cells decode as None."""
+        self._bound_key_cache()
+        if not self.group_cols:  # global session: one key ()
+            k = canon_key(())
+            code = self._code_of.get(k)
+            if code is None:
+                code = len(self._code_rev)
+                self._code_of[k] = code
+                self._code_rev.append(k)
+            return np.full(n, code, np.int64)
+        col_vals: list[list] = []
+        col_codes: list[np.ndarray] = []
+        for cname in self.group_cols:
+            arr = cols.get(cname)
+            if arr is None:
+                col_vals.append([None])
+                col_codes.append(np.zeros(n, np.int64))
+                continue
+            a = np.asarray(arr)
+            if kept is not None:
+                a = a[kept]
+            nm = nulls.get(cname) if nulls else None
+            if nm is not None:
+                nm = np.asarray(nm, np.bool_)
+                if kept is not None:
+                    nm = nm[kept]
+                if not nm.any():
+                    nm = None
+            if a.dtype.kind == "O":
+                return self._key_codes_cols_slow(cols, nulls, kept, n)
+            uniq, inv = np.unique(a, return_inverse=True)
+            vals = uniq.tolist()  # python scalars: canon/dict semantics
+            codes = inv.astype(np.int64)
+            if nm is not None:
+                vals = [None] + vals
+                codes = np.where(nm, 0, codes + 1)
+            col_vals.append(vals)
+            col_codes.append(codes)
+        if len(col_vals) == 1:
+            vals, codes = col_vals[0], col_codes[0]
+            lut = np.empty(len(vals), np.int64)
+            for p, v in enumerate(vals):
+                lut[p] = self._code_for(canon_key((v,)))
+            return lut[codes]
+        radix = 1
+        for vals in col_vals:
+            radix *= max(len(vals), 1)
+        if radix >= (1 << 62):  # mixed-radix would overflow int64
+            return self._key_codes_cols_slow(cols, nulls, kept, n)
+        combined = col_codes[0]
+        for codes, vals in zip(col_codes[1:], col_vals[1:]):
+            combined = combined * len(vals) + codes
+        u, inv = np.unique(combined, return_inverse=True)
+        lut = np.empty(len(u), np.int64)
+        for j, cu in enumerate(u.tolist()):
+            idxs = []
+            for vals in reversed(col_vals[1:]):
+                idxs.append(cu % len(vals))
+                cu //= len(vals)
+            idxs.append(cu)
+            idxs.reverse()
+            key = canon_key(tuple(col_vals[g][i]
+                                  for g, i in enumerate(idxs)))
+            lut[j] = self._code_for(key)
+        return lut[inv]
+
+    def _code_for(self, key: tuple) -> int:
+        code = self._code_of.get(key)
+        if code is None:
+            code = len(self._code_rev)
+            self._code_of[key] = code
+            self._code_rev.append(key)
+        return code
+
+    def _key_codes_cols_slow(self, cols, nulls, kept, n: int
+                             ) -> np.ndarray:
+        """Object-column fallback: one memoized dict hit per row over
+        raw value tuples (the _key_codes discipline)."""
+        parts: list[list] = []
+        for cname in self.group_cols:
+            arr = cols.get(cname)
+            if arr is None:
+                parts.append([None] * n)
+                continue
+            a = np.asarray(arr)
+            if kept is not None:
+                a = a[kept]
+            vals = a.tolist()
+            nm = nulls.get(cname) if nulls else None
+            if nm is not None:
+                nm = np.asarray(nm, np.bool_)
+                if kept is not None:
+                    nm = nm[kept]
+                if nm.any():
+                    vals = [None if isnull else v
+                            for v, isnull in zip(vals, nm.tolist())]
+            parts.append(vals)
+        memo = self._raw_memo
+        out = np.empty(n, np.int64)
+        rows_iter = zip(*parts) if len(parts) > 1 \
+            else ((v,) for v in parts[0])
+        for i, raw in enumerate(rows_iter):
+            code = memo.get(raw)
+            if code is None:
+                code = self._code_for(canon_key(raw))
+                memo[raw] = code
+            out[i] = code
+        return out
+
+    def _typed_cols(self, cols, nulls, kept, n: int):
+        """Schema-typed device columns + per-column null masks from raw
+        columnar input — the same NULL rules as HostBatch.from_rows
+        (None / non-scalar numeric cells are SQL NULL; strings stringify
+        and dictionary-encode)."""
+        dcols: dict[str, np.ndarray] = {}
+        dnulls: dict[str, np.ndarray] = {}
+        for name, _tag in self._dev["layout"]:
+            want = self.schema.type_of(name)
+            raw = cols.get(name)
+            msk = np.zeros(n, np.bool_)
+            nm = nulls.get(name) if nulls else None
+            if nm is not None:
+                nm = np.asarray(nm, np.bool_)
+                msk |= nm[kept] if kept is not None else nm
+            if raw is None:
+                dcols[name] = np.zeros(
+                    n, np.int32 if want == ColumnType.STRING
+                    else np.float32)
+                dnulls[name] = np.ones(n, np.bool_)
+                continue
+            a = np.asarray(raw)
+            if kept is not None:
+                a = a[kept]
+            if want == ColumnType.STRING:
+                enc = self.dicts[name].encode
+                out = np.empty(n, np.int32)
+                for i, v in enumerate(a.tolist()):
+                    if v is None:
+                        out[i] = -1
+                        msk[i] = True
+                    else:
+                        out[i] = enc(str(v))
+            else:
+                dt = (np.bool_ if want == ColumnType.BOOL
+                      else np.int32 if want == ColumnType.INT
+                      else np.float32)
+                if a.dtype.kind in "fiub":
+                    out = a.astype(dt)
+                else:
+                    out = np.zeros(n, dt)
+                    for i, v in enumerate(a.tolist()):
+                        if v is None or not isinstance(
+                                v, (int, float, bool)):
+                            msk[i] = True
+                        else:
+                            out[i] = v
+            dcols[name] = out
+            if msk.any():
+                dnulls[name] = msk
+        return dcols, (dnulls or None)
+
+    def _advance_and_close_device(self, pre_max: int):
+        """Watermark advance + close cycle for a batch whose records all
+        filtered out — the wm still moves (it is computed pre-filter)."""
+        if pre_max > self.watermark:
+            self.watermark = pre_max
+        out = self._close_due_device()
+        return out if out else []
+
+    # contract: dispatches<=1 fetches<=0
+    def _process_device(self, codes, ts, feed, pre_max):
+        """One device micro-batch: mirror-side late walk + segmentation
+        + chain merge (numpy), then ONE fused kernel dispatch and NO
+        fetch — the session ingest contract. Closes ride
+        _close_due_device (their own one-dispatch-one-fetch budget)."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        gap = self.window.gap_ms
+        grace = self.window.grace_ms
+        n = len(codes)
+        self.session_stats["batches"] += 1
+        if n and self.watermark >= 0 \
+                and int(ts.min()) + gap + grace <= self.watermark:
+            keep = self._late_keep_mask(codes, ts)
+            if not keep.all():
+                idx = np.nonzero(keep)[0]
+                codes = codes[idx]
+                ts = ts[idx]
+                feed = self._subset_feed(feed, idx)
+                n = len(codes)
+        if n:
+            # shared segmentation: per-key gap-chains of this batch —
+            # ONE combined-key argsort (codes are < 2^22 and the span is
+            # int32-bounded, so code*span+ts fits int64; ties are
+            # commutative-merge-equal, so stability is not needed)
+            tmin = int(ts.min())
+            span = int(ts.max()) - tmin + 1
+            order = np.argsort(codes * span + (ts - tmin))
+            ks = codes[order]
+            tss = ts[order]
+            brk = np.empty(n, np.bool_)
+            brk[0] = True
+            brk[1:] = (ks[1:] != ks[:-1]) | ((tss[1:] - tss[:-1]) > gap)
+            starts = np.nonzero(brk)[0]
+            ends = np.append(starts[1:], n)
+            seg_code = ks[starts]
+            seg_t0 = tss[starts]
+            seg_t1 = tss[ends - 1]
+            live = dev["mir_live"]
+            mcode, mt0, mt1, fanin = merge_chains_np(
+                np.concatenate([dev["mir_code"][live], seg_code]),
+                np.concatenate([dev["mir_t0"][live], seg_t0]),
+                np.concatenate([dev["mir_t1"][live], seg_t1]),
+                gap, n_first=int(live.sum()))
+            if fanin > self.chain_merge_limit:
+                self._degrade_to_host(
+                    f"one session chain merged {fanin} open sessions "
+                    f"(> chain_merge_limit {self.chain_merge_limit})")
+                return _DEGRADED
+            if len(mcode) > dev["cap"]:
+                self._grow_arena(len(mcode))
+            if self.epoch is None:
+                self.epoch = int(mt0.min())
+            # close_cut is compared against PRE-shift arena times in the
+            # kernel, so compute it in the OLD epoch before any rebase.
+            # In range by construction: |closed_wm - epoch| < the span
+            # bound below and 2*gap + grace < 2^30 (activation guard).
+            close_cut = np.int32(-(1 << 30)) if self._closed_wm < 0 else \
+                np.int32(self._closed_wm - 2 * gap - grace - self.epoch)
+            delta = self._maybe_rebase_dev(int(mt1.max()), int(mt0.min()))
+            if int(mt1.max()) - self.epoch >= self.REBASE_THRESHOLD:
+                # the rebase could not reclaim range (an ancient session
+                # pins the anchor): past this bound the kernels' scan
+                # arithmetic and the t0 scatter identity stop covering
+                # the values — the HOST engine has no such bound, so
+                # degrade instead of dying (found by code review: a
+                # pinned anchor + ~12 days of stream time desynced the
+                # mirror and crash-looped the query)
+                self._degrade_to_host(
+                    "relative stream span reached the device range "
+                    "(an old session is still open); host engine "
+                    "continues without the int32 bound")
+                return _DEGRADED
+            try:
+                if FAULTS.active:  # chaos: fail/delay a session step
+                    FAULTS.point("device.session.dispatch")
+                if dev["mode"] == "record":
+                    dev["arena"] = self._dispatch_record_step(
+                        codes, ts, feed, close_cut, delta)
+                else:
+                    dev["arena"] = self._dispatch_segment_merge(
+                        feed, order, starts, ends, np.cumsum(brk) - 1,
+                        seg_code, seg_t0, seg_t1, close_cut, delta)
+            except Exception as e:  # noqa: BLE001 — dispatch failed
+                # before any state mutation (functional update): the
+                # host path continues from the unchanged arena
+                self._degrade_to_host(
+                    f"step dispatch failed "
+                    f"({type(e).__name__}: {e})")
+                return _DEGRADED
+            self.session_stats["step_dispatches"] += 1
+            dev["mir_code"] = mcode
+            dev["mir_t0"] = mt0
+            dev["mir_t1"] = mt1
+            dev["mir_live"] = np.ones(len(mcode), np.bool_)
+        return self._advance_and_close_device(pre_max)
+
+    @staticmethod
+    def _subset_feed(feed, idx):
+        """Apply a keep-index to either feed shape (late-record drops)."""
+        if feed[0] == "record":
+            _tag, cols, nulls = feed
+            return ("record",
+                    {k: np.asarray(v)[idx] for k, v in cols.items()},
+                    None if nulls is None else
+                    {k: np.asarray(v)[idx] for k, v in nulls.items()})
+        _tag, vv = feed
+        return ("segment", [
+            None if e is None else (e[0][idx], e[1][idx]) for e in vv])
+
+    def _dispatch_record_step(self, codes, ts, feed, close_cut, delta):
+        """Record-mode dispatch: pack raw records into one int32 wire
+        buffer (compact — H2D bytes dominate on tunneled accelerators)
+        and run the fully fused sort+scan+scatter step."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        _tag, cols, nulls = feed
+        n = len(codes)
+        ts_rel = (ts - self.epoch).astype(np.int64)
+        bcap = self._dev_bcap(n)
+        null_masks = []
+        for refs in dev["null_refs"]:
+            m = np.zeros(n, np.bool_)
+            if nulls:
+                for c in refs:
+                    nm = nulls.get(c)
+                    if nm is not None:
+                        m |= np.asarray(nm, np.bool_)[:n]
+            null_masks.append(m if m.any() else None)
+        packed = lattice.pack_batch_host(
+            bcap, n, codes.astype(np.int32), ts_rel, None, cols,
+            null_masks, dev["layout"])
+        step = lattice.session_step_kernel(
+            dev["spec"], self.schema, dev["layout"], dev["cap"], bcap)
+        return step(dev["arena"], packed, np.int32(self.window.gap_ms),
+                    close_cut, np.int32(delta))
+
+    def _dispatch_segment_merge(self, feed, order, starts, ends,
+                                seg_of_row_sorted, seg_code, seg_t0,
+                                seg_t1, close_cut, delta):
+        """Segment-mode dispatch: reduce the batch's rows into
+        per-segment plane contributions with the host path's vectorized
+        machinery (reduceat / add.at — exact, segments are gap-chains)
+        and merge the segment arena into the session arena on device."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        _tag, vv = feed
+        seg = self._segment_planes(vv, order, starts, ends,
+                                   seg_of_row_sorted, seg_code,
+                                   seg_t0 - self.epoch,
+                                   seg_t1 - self.epoch)
+        kern = lattice.session_merge_kernel(dev["spec"], dev["cap"],
+                                            len(seg["code"]))
+        return kern(dev["arena"], seg, np.int32(self.window.gap_ms),
+                    close_cut, np.int32(delta))
+
+    def _segment_planes(self, vv, order, starts, ends, seg_of_row,
+                        seg_code, seg_t0_rel, seg_t1_rel
+                        ) -> dict[str, np.ndarray]:
+        """Per-segment arena-format planes (numpy, padded to a sticky
+        pow2 segment capacity) — the same reductions as the host path's
+        _segment_accs, emitted in device plane layout."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        spec = dev["spec"]
+        nseg = len(starts)
+        scap = self._dev_scap(nseg)
+        seg: dict[str, np.ndarray] = {
+            "code": np.full(scap, lattice.SESSION_SENT_CODE, np.int32),
+            "t0": np.zeros(scap, np.int32),
+            "t1": np.zeros(scap, np.int32),
+        }
+        seg["code"][:nseg] = seg_code.astype(np.int32)
+        seg["t0"][:nseg] = seg_t0_rel
+        seg["t1"][:nseg] = seg_t1_rel
+        seg_len = None
+        sorted_cache: dict = {}
+        for i, (name, a) in enumerate(zip(
+                lattice.session_plane_names(spec), spec.aggs)):
+            if name in seg:
+                continue  # aliased plane (p50+p99 share the histogram)
+            if a.kind == AggKind.COUNT_ALL:
+                if seg_len is None:
+                    seg_len = (ends - starts).astype(np.int64)
+                plane = np.zeros(scap, np.int32)
+                plane[:nseg] = seg_len
+                seg[name] = plane
+                continue
+            vals, ok = vv[i]
+            hit = sorted_cache.get(id(vals))
+            if hit is None:
+                hit = (vals[order], ok[order])
+                sorted_cache[id(vals)] = hit
+            vs, okv = hit
+            if a.kind == AggKind.COUNT:
+                plane = np.zeros(scap, np.int32)
+                plane[:nseg] = np.add.reduceat(okv.astype(np.int64),
+                                               starts)
+            elif a.kind == AggKind.SUM:
+                plane = np.zeros(scap, np.float32)
+                plane[:nseg] = np.add.reduceat(np.where(okv, vs, 0.0),
+                                               starts)
+            elif a.kind == AggKind.AVG:
+                plane = np.zeros(scap, np.float32)
+                plane[:nseg] = np.add.reduceat(np.where(okv, vs, 0.0),
+                                               starts)
+                pn = np.zeros(scap, np.int32)
+                pn[:nseg] = np.add.reduceat(okv.astype(np.int64), starts)
+                seg[name + "_n"] = pn
+            elif a.kind == AggKind.MIN:
+                plane = np.full(scap, np.inf, np.float32)
+                plane[:nseg] = np.minimum.reduceat(
+                    np.where(okv, vs, np.inf), starts)
+            elif a.kind == AggKind.MAX:
+                plane = np.full(scap, -np.inf, np.float32)
+                plane[:nseg] = np.maximum.reduceat(
+                    np.where(okv, vs, -np.inf), starts)
+            elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
+                plane = np.zeros((scap, self.hll.m), np.int8)
+                reg, rank = hll_update_np(
+                    np.where(okv, vs, 0.0).astype(np.float32), self.hll)
+                np.maximum.at(plane, (seg_of_row[okv], reg[okv]),
+                              rank[okv])
+            elif a.kind == AggKind.APPROX_QUANTILE:
+                nb = self.qcfg.n_bins
+                b = quantile_bin_np(
+                    np.where(okv, vs, self.qcfg.min_value), self.qcfg)
+                # bincount over the flattened (segment, bin) space is
+                # ~5x np.add.at for the same scattered histogram
+                flat = seg_of_row[okv] * nb + b[okv]
+                plane = np.bincount(
+                    flat, minlength=scap * nb).astype(
+                    np.int32).reshape(scap, nb)
+            else:
+                raise SQLCodegenError(
+                    f"session agg {a.kind} unsupported")
+            seg[name] = plane
+        return seg
+
+    def _dev_scap(self, nseg: int) -> int:
+        # the shape-stability twin of _dev_bcap, floored lower —
+        # segments are few
+        return self._sticky_cap(self._dev["scaps"], nseg, 256)
+
+    def _late_keep_mask(self, codes, ts) -> np.ndarray:
+        """The order-dependent part of the reference semantics: walk the
+        batch in (per-key) ts order over the INTERVAL mirror, dropping
+        records that are past grace AND cannot merge into any session
+        alive at their turn (SessionWindowedStream.hs:84-118). Interval
+        state only — no accumulators — so this host walk costs a few
+        list ops per record, and only on batches that actually carry
+        possibly-late records."""
+        gap = self.window.gap_ms
+        grace = self.window.grace_ms
+        wm = self.watermark
+        n = len(codes)
+        dev = self._dev
+        batch_keys = set(codes.tolist())
+        iv: dict[int, list[list[int]]] = {}
+        for slot in np.nonzero(dev["mir_live"])[0].tolist():
+            c = int(dev["mir_code"][slot])
+            if c in batch_keys:
+                iv.setdefault(c, []).append(
+                    [int(dev["mir_t0"][slot]), int(dev["mir_t1"][slot])])
+        keep = np.ones(n, np.bool_)
+        order = np.lexsort((ts, codes))
+        for p in order.tolist():
+            c = int(codes[p])
+            t = int(ts[p])
+            lst = iv.setdefault(c, [])
+            overl = [s for s in lst if s[0] - gap <= t <= s[1] + gap]
+            if not overl:
+                if t + gap + grace <= wm:
+                    keep[p] = False
+                    continue
+                lst.append([t, t])
+                continue
+            m = overl[0]
+            for s in overl[1:]:
+                m[0] = min(m[0], s[0])
+                m[1] = max(m[1], s[1])
+                lst.remove(s)
+            m[0] = min(m[0], t)
+            m[1] = max(m[1], t)
+        return keep
+
+    def _maybe_rebase_dev(self, max_ts: int, anchor: int) -> int:
+        """Re-anchor the device epoch when relative time nears int32
+        range; the returned delta rides the next step dispatch (the
+        kernel shifts arena times in the same fused pass)."""
+        if max_ts - self.epoch < self.REBASE_THRESHOLD:
+            return 0
+        delta = anchor - self.epoch
+        if delta <= 0:
+            return 0
+        self.epoch += delta
+        return delta
+
+    @staticmethod
+    def _sticky_cap(caps: set, n: int, lo: int) -> int:
+        """Sticky pow2 capacity (the _stage_cap discipline): each
+        distinct cap is its own compiled kernel, so varying sizes
+        converge on a handful of shapes; a size reuses the smallest
+        already-chosen cap within 8x padding."""
+        for c in sorted(caps):
+            if n <= c <= 8 * max(n, 1):
+                return c
+        cap = round_up_pow2(n, lo=lo)
+        caps.add(cap)
+        return cap
+
+    def _dev_bcap(self, n: int) -> int:
+        return self._sticky_cap(self._dev["bcaps"], n, 4096)
+
+    def _grow_arena(self, need: int) -> None:
+        """Double the arena capacity (pow2) — rare; compiled shapes
+        converge like grow_keys on the window lattice."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        new_cap = round_up_pow2(need, lo=dev["cap"] * 2)
+        dev["arena"] = lattice.grow_session_arena(
+            dev["spec"], dev["arena"], new_cap)
+        dev["cap"] = new_cap
+        self.session_stats["grows"] += 1
+
+    # contract: dispatches<=1 fetches<=0
+    def _compact_codes_device(self) -> None:
+        """Key-code compaction under the cache bound: keep only codes
+        with live sessions, reassign dense codes in sorted order (the
+        arena stays (code, t0)-sorted), remap the arena through the
+        pow2-padded LUT kernel — one dispatch, no fetch. Dead codes map
+        to the sentinel, so the remap doubles as eviction."""
+        import jax
+
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        live = dev["mir_live"]
+        # pending deferred closes still decode by their PRE-remap codes
+        # (the extracted device buffers are immutable): resolve their
+        # key columns against the old dictionary now
+        self._pending_closes = [
+            (codes, t0, t1, packed,
+             keys if keys is not None else
+             [arr[codes.astype(np.int64)]
+              for arr in self._code_rev_columns()])
+            for codes, t0, t1, packed, keys in self._pending_closes]
+        live_codes = np.unique(dev["mir_code"][live]).astype(np.int64)
+        lcap = round_up_pow2(max(len(self._code_rev), 1), lo=256)
+        lut = np.full(lcap, lattice.SESSION_SENT_CODE, np.int32)
+        lut[live_codes] = np.arange(len(live_codes), dtype=np.int32)
+        kern = lattice.session_remap_kernel(dev["cap"], lcap)
+        try:
+            dev["arena"] = kern(dev["arena"], jax.device_put(lut))
+        except Exception as e:  # noqa: BLE001 — arena unchanged
+            # (functional update): the host engine continues with the
+            # un-remapped caches; the device caller re-checks _dev
+            self._degrade_to_host(
+                f"code remap dispatch failed "
+                f"({type(e).__name__}: {e})")
+            return
+        self.session_stats["remap_dispatches"] += 1
+        new_code = np.full(len(dev["mir_code"]), -1, np.int64)
+        new_code[live] = np.searchsorted(live_codes,
+                                         dev["mir_code"][live])
+        dev["mir_code"] = new_code
+        new_rev = [self._code_rev[int(c)] for c in live_codes]
+        self._code_rev = new_rev
+        self._code_of = {k: i for i, k in enumerate(new_rev)}
+        self._raw_memo = {}
+        self._code_cols_cache = (-1, [])
+
+    # contract: dispatches<=1 fetches<=1
+    def _close_due_device(self):
+        """Close every session past end + 2*gap + grace: the mirror
+        names the due slots, ONE pow2-padded extract dispatch finalizes
+        them on device, ONE fetch brings the packed buffer down, and the
+        decode is columnar (ColumnarEmit). With defer_close_decode the
+        fetch is deferred: drain_closed() later stacks every pending
+        cycle into one transfer per buffer shape. The arena retires the
+        closed entries lazily on the next step dispatch (close_cut)."""
+        dev = self._dev
+        gap = self.window.gap_ms
+        grace = self.window.grace_ms
+        if self.watermark < 0:
+            return []
+        due = dev["mir_live"] & (dev["mir_t1"] + 2 * gap + grace
+                                 <= self.watermark)
+        idx = np.nonzero(due)[0]
+        if len(idx) == 0:
+            return []
+        self.session_stats["close_cycles"] += 1
+        # the mirror rows are snapshotted NOW: the mirror mutates on the
+        # next step, the deferred decode must not see that
+        codes = dev["mir_code"][idx].copy()
+        t0 = dev["mir_t0"][idx].copy()
+        t1 = dev["mir_t1"][idx].copy()
+        self.session_stats["close_dispatches"] += 1
+        try:
+            packed_dev = self._dispatch_extract(idx)
+        except Exception as e:  # noqa: BLE001 — nothing retired yet:
+            # the host engine closes the same due set from the pulled-
+            # back state (a FETCH failure later still propagates — by
+            # then the buffers are the only copy of those rows)
+            self._degrade_to_host(
+                f"close extract dispatch failed "
+                f"({type(e).__name__}: {e})")
+            return self.close_due_sessions()
+        dev["mir_live"][idx] = False
+        self._closed_wm = max(self._closed_wm, self.watermark)
+        if self.defer_close_decode:
+            # keep the packed batch as a device value; no host sync
+            self._pending_closes.append((codes, t0, t1, packed_dev,
+                                         None))
+            return []
+        self.session_stats["close_fetches"] += 1
+        return self._decode_close(np.asarray(packed_dev), codes, t0, t1)
+
+    def _dispatch_extract(self, idx: np.ndarray):
+        """One pow2-padded extract dispatch over the named arena slots;
+        returns the packed device value (the caller fetches or defers)."""
+        from hstream_tpu.engine import lattice
+
+        dev = self._dev
+        slots = lattice.pad_slots(idx)
+        if FAULTS.active:  # chaos: fail/delay a session extract
+            FAULTS.point("device.session.dispatch")
+        kern = lattice.session_extract_kernel(dev["spec"], dev["cap"],
+                                              len(slots))
+        return kern(dev["arena"], slots)
+
+    # contract: dispatches<=0 fetches<=1
+    def drain_closed(self) -> list[dict[str, Any]]:
+        """Decode every deferred session close. Multiple pending close
+        cycles fetch in ONE device->host transfer per buffer shape
+        (stack_pow2) — fetch count, not bytes, dominates drain cost on
+        real links. A fetch failure here propagates: the closed slots'
+        mirror entries are already retired, so task death + supervised
+        restart from snapshot is the correct recovery (the PR 8 drain
+        rule)."""
+        from hstream_tpu.engine import lattice
+
+        if not self._pending_closes:
+            return []
+        out = None
+        if len(self._pending_closes) == 1:
+            codes, t0, t1, packed_dev, keys = self._pending_closes[0]
+            self.session_stats["close_fetches"] += 1
+            out = self._decode_close(np.asarray(packed_dev), codes, t0,
+                                     t1, keys)
+            self._pending_closes.clear()  # only after decode succeeded
+            return out if out is not None else []
+        by_shape: dict[tuple, list[tuple]] = {}
+        for ent in self._pending_closes:
+            by_shape.setdefault(tuple(ent[3].shape), []).append(ent)
+        for group in by_shape.values():
+            self.session_stats["close_fetches"] += 1
+            stacked = np.asarray(lattice.stack_pow2(
+                [p for _c, _a, _b, p, _k in group]))
+            for (codes, t0, t1, _, keys), packed in zip(group, stacked):
+                out = extend_rows(
+                    out, self._decode_close(packed, codes, t0, t1, keys))
+        self._pending_closes.clear()
+        return out if out is not None else []
+
+    def has_pending_closes(self) -> bool:
+        return bool(self._pending_closes)
+
+    def flush_changes(self) -> list[dict[str, Any]]:
+        """API parity with QueryExecutor's drain surface: sessions have
+        no deferred changelog, so flushing delivers any deferred closes."""
+        return self.drain_closed()
+
+    # contract: dispatches<=0 fetches<=1
+    def block_until_ready(self) -> None:
+        if self._dev is not None:
+            import jax
+
+            jax.block_until_ready(self._dev["arena"])
+
+    def _decode_close(self, packed: np.ndarray, codes, t0, t1,
+                      keys=None):
+        k = len(codes)
+        if not np.array_equal(packed[0, :k], codes):
+            raise AssertionError(
+                "session mirror diverged from device arena codes")
+        return self._decode_device_rows(packed, codes, t0, t1, keys)
+
+    def _decode_device_rows(self, packed: np.ndarray, codes, t0, t1,
+                            keys=None):
+        """Fetched extract buffer -> ColumnarEmit: key decode is a
+        cached reverse-index gather, agg values are already finalized on
+        device (counts/HLL i32, floats f32-bitcast), window bounds come
+        from the mirror snapshot taken at dispatch time."""
+        n = len(codes)
+        cols: dict[str, Any] = {}
+        if keys is not None:  # resolved before a code-space compaction
+            for name, arr in zip(self.group_cols, keys):
+                cols[name] = arr
+        else:
+            for name, arr in zip(self.group_cols,
+                                 self._code_rev_columns()):
+                cols[name] = arr[codes.astype(np.int64)]
+        row = 1
+        for a in self.aggs:
+            v = np.ascontiguousarray(packed[row, :n])
+            if a.kind in (AggKind.COUNT_ALL, AggKind.COUNT,
+                          AggKind.APPROX_COUNT_DISTINCT):
+                cols[a.out_name] = v.astype(np.int64)
+            else:
+                cols[a.out_name] = v.view(np.float32).astype(np.float64)
+            row += 1
+        cols["winStart"] = t0.astype(np.int64)
+        cols["winEnd"] = (t1 + self.window.gap_ms).astype(np.int64)
+        return self._postprocess_session_cols(cols, n)
+
+    def _code_rev_columns(self) -> list[np.ndarray]:
+        """Per-group-column object arrays over the code dictionary for
+        vectorized key decode; rebuilt only when codes changed."""
+        version = len(self._code_rev)
+        if self._code_cols_cache[0] != version:
+            out = []
+            for g in range(len(self.group_cols)):
+                arr = np.empty(version, object)
+                for i, key in enumerate(self._code_rev):
+                    arr[i] = key[g]
+                out.append(arr)
+            self._code_cols_cache = (version, out)
+        return self._code_cols_cache[1]
+
+    # contract: dispatches<=1 fetches<=1
+    def _peek_device(self):
+        """Open-session rows without touching state: one read-only
+        extract dispatch over every live slot + one fetch."""
+        dev = self._dev
+        idx = np.nonzero(dev["mir_live"])[0]
+        if len(idx) == 0:
+            return []
+        self.session_stats["peek_dispatches"] += 1
+        try:
+            packed_dev = self._dispatch_extract(idx)
+        except Exception as e:  # noqa: BLE001 — read-only: degrade and
+            # peek the pulled-back host state instead
+            self._degrade_to_host(
+                f"peek extract dispatch failed "
+                f"({type(e).__name__}: {e})")
+            return self.peek()
+        return self._decode_close(np.asarray(packed_dev),
+                                  dev["mir_code"][idx].copy(),
+                                  dev["mir_t0"][idx].copy(),
+                                  dev["mir_t1"][idx].copy())

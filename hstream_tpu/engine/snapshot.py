@@ -361,11 +361,23 @@ def _scatter_state(ex, canonical: dict[str, np.ndarray]):
 # ---- session ----------------------------------------------------------------
 
 def _session_state(ex) -> dict:
+    if getattr(ex, "_pending_closes", None):
+        # the deferred extract buffers are the ONLY copy of those
+        # closed-session rows (mirror entries already retired)
+        raise SQLCodegenError(
+            "snapshot with deferred session closes pending; "
+            "drain_closed() first")
+    # device-resident sessions serialize through the host-format view
+    # (one pytree fetch + acc decode); restore rebuilds the host engine
+    # and the device path re-activates and re-migrates lazily on the
+    # next batch, like the join store
+    src = (ex._host_sessions_view()
+           if getattr(ex, "_dev", None) is not None else ex.sessions)
     sessions = [
         {"k": _enc(key),
          "s": [{"a": s.start, "b": s.end, "acc": _enc(s.accs)}
                for s in sess_list]}
-        for key, sess_list in ex.sessions.items()
+        for key, sess_list in src.items()
     ]
     return {
         "kind": "session",

@@ -523,8 +523,9 @@ class QueryTask(threading.Thread):
 
     def _flush_deferred_changes(self) -> None:
         """Drain deferred changelog extracts (queued, async-drain, or
-        join-coalesced) to the sink — idle ticks and pre-snapshot; the
-        snapshot guard requires an empty queue."""
+        join-coalesced) AND deferred session closes to the sink — idle
+        ticks and pre-snapshot; the snapshot guards require an empty
+        queue on both surfaces."""
         with self.state_lock:  # executor is guarded (hstream-analyze)
             ex = self.executor
         if ex is None:
@@ -532,6 +533,8 @@ class QueryTask(threading.Thread):
         hp = getattr(ex, "has_pending_changes", None)
         pending = (hp() if hp is not None
                    else bool(getattr(ex, "_pending_changes", None)))
+        hc = getattr(ex, "has_pending_closes", None)
+        pending = pending or (hc is not None and hc())
         if not pending:
             return
         with self.state_lock:
@@ -810,6 +813,15 @@ class QueryTask(threading.Thread):
                 self.executor = self._make_executor(
                     _sample_rows(ts, cols, nulls), len(ts))
             ex = self.executor
+            if not self.is_join and getattr(
+                    ex, "supports_columnar_sessions", False):
+                # session executors take the batch COLUMNAR too (device
+                # session lattice): no row dicts, vectorized key encode
+                out = self._run_session_cols(ex, ts, cols, nulls)
+                if out:
+                    with trace_span(self.tracer, "emit"):
+                        self.sink(out)
+                return
             if self.is_join or not hasattr(ex, "process_columnar"):
                 if self.is_join and getattr(ex, "supports_columnar_join",
                                             False):
@@ -902,7 +914,9 @@ class QueryTask(threading.Thread):
             if self.executor is None:
                 self.executor = self._make_executor(rows, len(rows))
             ex = self.executor
-            if not self.is_join and hasattr(ex, "process_columnar"):
+            if not self.is_join and hasattr(ex, "process_columnar") \
+                    and not getattr(ex, "supports_columnar_sessions",
+                                    False):
                 # vectorized JSON ingest: one pass per needed column into
                 # the same staged columnar path producer batches use
                 # (SURVEY §7 "protobuf decode off the critical path")
@@ -946,13 +960,20 @@ class QueryTask(threading.Thread):
                 self.executor = self._make_executor(
                     _sample_rows(ts, cols), len(ts))
             ex = self.executor
+            if not self.is_join and getattr(
+                    ex, "supports_columnar_sessions", False):
+                out = self._run_session_cols(ex, ts, cols, None)
+                if out:
+                    with trace_span(self.tracer, "emit"):
+                        self.sink(out)
+                return
             if self.is_join or not hasattr(ex, "process_columnar"):
                 if self.is_join and getattr(ex, "supports_columnar_join",
                                             False):
                     out = self._run_join_cols(
                         ex, ts, _plain_columns(cols), None, logid)
                 else:
-                    # sessions / stateless: row materialization
+                    # stateless: row materialization
                     with trace_span(self.tracer, "decode"):
                         rws = columnar.to_rows(ts, cols)
                     with trace_span(self.tracer, "step"):
@@ -986,6 +1007,14 @@ class QueryTask(threading.Thread):
             with trace_span(self.tracer, "emit"):
                 self.sink(out)
 
+    def _run_session_cols(self, ex, ts, cols, nulls):
+        """Columnar dispatch into a session executor (device session
+        lattice, engine.session): string columns pre-gathered through
+        their payload dictionaries into fixed-width unicode arrays, so
+        the session key encoder factorizes them at C speed."""
+        with trace_span(self.tracer, "step"):
+            return ex.process_columnar(ts, _session_columns(cols), nulls)
+
     def _run_join_cols(self, ex, ts, plain, nulls, logid):
         """Columnar dispatch into a stream-stream join executor."""
         with trace_span(self.tracer, "step"):
@@ -1017,6 +1046,23 @@ class QueryTask(threading.Thread):
             if rows:
                 with trace_span(self.tracer, "emit"):
                     self.sink(rows)
+
+
+def _session_columns(cols: dict) -> dict:
+    """Decoded payload columns -> the session executor's columnar feed:
+    like _plain_columns, but string columns gather into fixed-width
+    unicode arrays (one vectorized fancy-index) instead of object
+    arrays — the session key encoder's np.unique factorization runs at
+    C speed on those and would fall back to a per-row memo loop on
+    object dtype."""
+    out = {}
+    for name, (kind, arr, d) in cols.items():
+        if kind == "str":
+            out[name] = np.asarray(d)[arr] if d else \
+                np.zeros(len(arr), "U1")
+        else:
+            out[name] = arr
+    return out
 
 
 def _plain_columns(cols: dict) -> dict:

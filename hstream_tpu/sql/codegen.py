@@ -364,8 +364,8 @@ def mesh_exclusion_reason(plan: plans.Plan) -> str | None:
     node = plan.node
     if isinstance(node, AggregateNode) and isinstance(node.window,
                                                       SessionWindow):
-        return ("session windows merge-on-overlap on the host; "
-                "segmentation is vectorized but not mesh-sharded")
+        return ("session windows run on the single-chip session "
+                "lattice; the chain-merge arena is not mesh-sharded yet")
     if not isinstance(node, AggregateNode):
         return "stateless plans have no device state to shard"
     if any(a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)

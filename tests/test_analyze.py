@@ -810,6 +810,66 @@ def test_dispatch_host_typed_asarray_not_a_fetch():
     assert run_one(dispatch, [src(HOT, code)]) == []
 
 
+SESSION_HOT = "hstream_tpu/engine/session.py"  # ISSUE 10 hot-path rel
+
+
+def test_dispatch_session_kernels_are_dispatch_sites():
+    """The session kernel factories count as dispatches: a second step
+    dispatch (or a per-cycle fetch loop) inside a session contract
+    function blows the budget — the shape the fused session step
+    exists to prevent."""
+    code = '''
+    import numpy as np
+    from hstream_tpu.engine import lattice
+
+    class SessionExecutor:
+        # contract: dispatches<=1 fetches<=0
+        def _process_device(self, packed):
+            step = lattice.session_step_kernel(
+                self.spec, self.schema, self.layout, 512, 4096)
+            a = step(self.arena, packed)
+            b = step(a, packed)      # second dispatch: budget blown
+            return b
+    '''
+    out = run_one(dispatch, [src(SESSION_HOT, code)])
+    assert len(out) == 1 and out[0].rule == "dispatch-budget"
+    assert "dispatch site(s)" in out[0].message
+
+
+def test_dispatch_session_extract_fetch_loop_flagged():
+    """A fetch per pending close cycle inside drain_closed — the
+    stacked pow2 drain exists to prevent exactly this."""
+    code = '''
+    import numpy as np
+    from hstream_tpu.engine import lattice
+
+    class SessionExecutor:
+        # contract: dispatches<=0 fetches<=1
+        def drain_closed(self):
+            out = []
+            for codes, packed in self._pending:
+                out.append(np.asarray(packed))
+            return out
+    '''
+    out = run_one(dispatch, [src(SESSION_HOT, code)])
+    assert rules_of(out) == {"dispatch-budget"}
+    assert "loop" in out[0].message
+
+
+def test_dispatch_session_unannotated_sync_flagged():
+    """session.py is a dispatch-sync hot-path file now: a bare device
+    sync without a contract budget is a hot-path regression."""
+    code = '''
+    import numpy as np
+
+    class SessionExecutor:
+        def _peek_device(self):
+            return np.asarray(self._dev["arena"]["code"])
+    '''
+    out = run_one(dispatch, [src(SESSION_HOT, code)])
+    assert len(out) == 1 and out[0].rule == "dispatch-sync"
+
+
 def test_dispatch_contract_syntax_error_flagged():
     code = '''
     class Ex:
@@ -927,6 +987,33 @@ def test_retrace_raw_len_shape_key_flagged():
     assert len(out) == 1 and out[0].rule == "retrace-shape-key"
     ok = bad.replace("len(batch)", "bcap")
     assert run_one(retrace, [src("m.py", ok)]) == []
+
+
+def test_retrace_session_factory_raw_len_shape_key_flagged():
+    """The session kernel factories key their compile cache on the
+    pow2-padded batch/segment capacity; a raw len() defeats it —
+    one XLA executable per distinct batch size (ISSUE 10)."""
+    bad = '''
+    from hstream_tpu.engine import lattice
+
+    def step(dev, schema, batch, packed):
+        kern = lattice.session_step_kernel(
+            dev["spec"], schema, dev["layout"], dev["cap"], len(batch))
+        return kern(dev["arena"], packed)
+    '''
+    out = run_one(retrace, [src("m.py", bad)])
+    assert len(out) == 1 and out[0].rule == "retrace-shape-key"
+    ok = bad.replace("len(batch)", "bcap")
+    assert run_one(retrace, [src("m.py", ok)]) == []
+    # the merge-mode factory is covered too
+    bad2 = bad.replace("session_step_kernel(\n"
+                       "            dev[\"spec\"], schema, "
+                       "dev[\"layout\"], dev[\"cap\"], len(batch))",
+                       "session_merge_kernel(\n"
+                       "            dev[\"spec\"], dev[\"cap\"], "
+                       "len(batch))")
+    out2 = run_one(retrace, [src("m.py", bad2)])
+    assert len(out2) == 1 and out2[0].rule == "retrace-shape-key"
 
 
 # ---- overflow (ISSUE 7) ----------------------------------------------------
@@ -1172,6 +1259,26 @@ def test_retrace_guard_zero_steady_state_fused_close(retrace_guard):
         for i in range(warm, warm + 50):
             feed(i)
         ex.block_until_ready()
+
+
+def test_retrace_guard_zero_steady_state_device_session(retrace_guard):
+    """50 post-warmup device-session micro-batches (steps, close
+    extracts, stacked deferred drains) compile NOTHING (ISSUE 10)."""
+    import bench
+
+    ex, feed, warm = bench._smoke_session_config()
+    for b in range(warm):
+        feed(b)
+    ex.flush_changes()
+    ex.block_until_ready()
+    assert ex._dev is not None, "device sessions did not activate"
+    with retrace_guard():
+        for b in range(warm, warm + 50):
+            feed(b)
+        ex.flush_changes()
+        ex.block_until_ready()
+    st = ex.session_stats
+    assert st["step_dispatches"] == st["batches"]
 
 
 def test_retrace_guard_zero_steady_state_device_join(retrace_guard):

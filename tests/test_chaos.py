@@ -473,6 +473,123 @@ def test_join_activation_failure_stays_on_host_path_exactly():
     assert any(r.get("c") == 1 for r in got)  # the joins happened
 
 
+# ---- device sessions: failure degrades to the host reference (ISSUE 10) ----
+
+
+def _session_flow(sql_stream, view, stub, ctx, arm=None):
+    """Shared session scenario: session-window COUNT/SUM per user, a
+    batch extending sessions across micro-batches, then a far-future
+    closer. Returns the closed-session rows."""
+    stub.CreateStream(pb.Stream(stream_name=sql_stream))
+    stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text=f"CREATE VIEW {view} AS SELECT user, COUNT(*) AS c, "
+                  f"SUM(v) AS s FROM {sql_stream} GROUP BY user, "
+                  "SESSION (INTERVAL 2 SECOND) "
+                  "GRACE BY INTERVAL 0 SECOND;"))
+    qid = f"view-{view}"
+    wait_attached(ctx, qid)
+    if arm is not None:
+        arm()
+    append_rows(stub, sql_stream,
+                [{"user": "a", "v": 1.0}, {"user": "a", "v": 2.0},
+                 {"user": "b", "v": 5.0}],
+                [BASE, BASE + 500, BASE + 700])
+    # extends a's session cross-batch; b gets a second session later
+    append_rows(stub, sql_stream,
+                [{"user": "a", "v": 3.0}, {"user": "b", "v": 7.0}],
+                [BASE + 1500, BASE + 9000])
+    append_rows(stub, sql_stream, [{"user": "z", "v": 0.0}],
+                [BASE + 60_000])
+    rows = _poll_view(
+        stub, view,
+        lambda rs: any(r.get("user") == "b"
+                       and r.get("winStart") == BASE + 9000
+                       for r in rs))
+    return qid, _norm([r for r in rows if r.get("user") != "z"])
+
+
+def test_session_device_dispatch_failure_degrades_exactly():
+    """device.session.dispatch=fail:1 fires inside the first session
+    step dispatch. The executor must pull its state back to the host
+    reference engine — identical closed rows, query alive — and the
+    degradation must land in the device_path_fallbacks counter."""
+    server, ctx, stub, channel = _serve()
+    try:
+        _, want = _session_flow("ss0", "sv0", stub, ctx)
+    finally:
+        channel.close(); server.stop(grace=1); ctx.shutdown()
+    assert want  # the reference run closed real sessions
+
+    server, ctx, stub, channel = _serve()
+    try:
+        qid, got = _session_flow(
+            "ss1", "sv1", stub, ctx,
+            arm=lambda: ctx.faults.arm("device.session.dispatch",
+                                       "fail:1"))
+        assert got == want
+        task = ctx.running_queries[qid]
+        ex = task.executor
+        assert ex.device_fallbacks == 1
+        assert ex.use_device_sessions is False and ex._dev is None
+        # the task mirrored the degradation into the counter
+        task._note_device_fallbacks()
+        assert ctx.stats.stream_stat_get(
+            "device_path_fallbacks", "ss1") == 1
+        assert "fault_injected" in _event_kinds(ctx)
+        # degraded, not dead: the query is still RUNNING
+        assert ctx.persistence.get_query(qid).status == \
+            TaskStatus.RUNNING
+    finally:
+        channel.close(); server.stop(grace=1); ctx.shutdown()
+
+
+def test_session_device_activation_failure_stays_on_host_exactly():
+    """device.session.activate=fail:1 fires at arena activation: the
+    executor never migrates, stays on the host engine, and results are
+    identical (engine-level twin of the server scenario above)."""
+    from hstream_tpu.engine import ColumnType, Schema
+    from hstream_tpu.engine.expr import Col
+    from hstream_tpu.engine.plan import (
+        AggKind,
+        AggregateNode,
+        AggSpec,
+        SourceNode,
+    )
+    from hstream_tpu.engine.session import SessionExecutor
+    from hstream_tpu.engine.window import SessionWindow
+
+    schema = Schema.of(user=ColumnType.STRING, v=ColumnType.FLOAT)
+    batches = [
+        ([{"user": "a", "v": 1.0}, {"user": "b", "v": 2.0}],
+         [BASE, BASE + 500]),
+        ([{"user": "a", "v": 3.0}], [BASE + 1500]),
+        ([{"user": "z", "v": 0.0}], [BASE + 60_000]),
+    ]
+
+    def run(fault):
+        node = AggregateNode(
+            child=SourceNode("s", schema), group_keys=[Col("user")],
+            window=SessionWindow(2000, grace_ms=0),
+            aggs=[AggSpec(AggKind.COUNT_ALL, "c"),
+                  AggSpec(AggKind.SUM, "s", input=Col("v"))])
+        ex = SessionExecutor(node, schema, emit_changes=False)
+        if fault:
+            FAULTS.arm("device.session.activate", "fail:1")
+        out = []
+        for rows, ts in batches:
+            out.extend(ex.process(rows, ts))
+        FAULTS.disarm()
+        return ex, list(out)
+
+    ref, want = run(fault=False)
+    assert ref._dev is not None  # the reference actually ran on device
+    ex, got = run(fault=True)
+    assert _norm(got) == _norm(want)
+    assert ex.device_fallbacks == 1
+    assert ex.use_device_sessions is False and ex._dev is None
+    assert len(want) > 0
+
+
 # ---- the registry itself: determinism + hot-path discipline -----------------
 
 

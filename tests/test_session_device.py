@@ -1,0 +1,619 @@
+"""Device session lattice vs the host reference engine (ISSUE 10).
+
+The device path (engine.lattice session kernels + the SessionExecutor
+mirror) must be row-equivalent to the retained host merge engine across
+out-of-order rows straddling the gap timeout, late-record drops,
+cross-batch session extension, key growth + code-space compaction,
+snapshot roundtrips, and watermark-driven closes — in BOTH kernel modes
+(record: fully fused sort+scan step; segment: host-pre-reduced segment
+planes merged on device). Float aggregates compare with a small relative
+tolerance (the device accumulates in f32, the host in f64); counts,
+min/max of f32-exact values, and HLL registers compare exactly;
+APPROX_QUANTILE compares within one DDSketch bucket (bin edges are
+computed in f32 on device, f64 on host).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from hstream_tpu.engine import ColumnType, Schema
+from hstream_tpu.engine.expr import Col
+from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec, SourceNode
+from hstream_tpu.engine.session import SessionExecutor
+from hstream_tpu.engine.window import SessionWindow
+
+BASE = 1_700_000_000_000
+
+MODES = ["segment", "record"]
+
+SCHEMA = Schema.of(k=ColumnType.STRING, v=ColumnType.FLOAT)
+
+
+def make_ex(aggs, *, device, mode=None, gap=1000, grace=500,
+            emit_changes=False, having=None, projections=None):
+    node = AggregateNode(
+        child=SourceNode("s", SCHEMA), group_keys=[Col("k")],
+        window=SessionWindow(gap, grace_ms=grace), aggs=aggs,
+        having=having, post_projections=projections or [])
+    ex = SessionExecutor(node, SCHEMA, emit_changes=emit_changes)
+    ex.use_device_sessions = device
+    ex.device_session_mode = mode
+    return ex
+
+
+def gen(seed, n_batches=8, batch=300, keys=12, late_frac=0.15):
+    """Randomized workload with out-of-order rows straddling the gap
+    timeout and genuinely-late records (past grace under the
+    watermark). Values are small integers so f32 sums stay exact."""
+    rng = np.random.default_rng(seed)
+    batches, t = [], BASE
+    for _ in range(n_batches):
+        ks = rng.integers(0, keys, batch)
+        ts = t + rng.integers(0, 4000, batch)
+        late = rng.random(batch) < late_frac
+        ts = np.where(late, ts - rng.integers(3000, 20_000, batch), ts)
+        vs = rng.integers(0, 1000, batch)
+        rows = [{"k": f"u{int(k)}", "v": float(v)}
+                for k, v in zip(ks, vs)]
+        batches.append((rows, ts.tolist()))
+        t += 2500
+    return batches
+
+
+def assert_rows_close(got, want, rtol=1e-5):
+    """Row-set equality with relative tolerance on float fields (rows
+    matched by their exact non-float fields)."""
+    def key(r):
+        return tuple(sorted((k, v) for k, v in r.items()
+                            if not isinstance(v, float)))
+
+    gd: dict = {}
+    wd: dict = {}
+    for r in got:
+        gd.setdefault(key(r), []).append(r)
+    for r in want:
+        wd.setdefault(key(r), []).append(r)
+    assert set(gd) == set(wd), sorted(set(gd) ^ set(wd))[:4]
+    for k in gd:
+        assert len(gd[k]) == len(wd[k]), k
+        for rg, rw in zip(
+                sorted(gd[k], key=lambda r: sorted(r.items(), key=str)),
+                sorted(wd[k], key=lambda r: sorted(r.items(), key=str))):
+            for c, v in rw.items():
+                if isinstance(v, float):
+                    assert np.isclose(rg[c], v, rtol=rtol,
+                                      atol=1e-9), (k, c, rg[c], v)
+
+
+EXACT_AGGS = [
+    AggSpec(AggKind.COUNT_ALL, "c"),
+    AggSpec(AggKind.COUNT, "n", input=Col("v")),
+    AggSpec(AggKind.SUM, "s", input=Col("v")),
+    AggSpec(AggKind.AVG, "a", input=Col("v")),
+    AggSpec(AggKind.MIN, "lo", input=Col("v")),
+    AggSpec(AggKind.MAX, "hi", input=Col("v")),
+    AggSpec(AggKind.APPROX_COUNT_DISTINCT, "d", input=Col("v")),
+]
+
+SKETCH_AGGS = [
+    AggSpec(AggKind.APPROX_QUANTILE, "p50", input=Col("v"), quantile=0.5),
+    AggSpec(AggKind.APPROX_QUANTILE, "p99", input=Col("v"),
+            quantile=0.99),
+]
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_device_host_equivalence_out_of_order(mode, seed):
+    """Random out-of-order + late workload: closed rows, open-session
+    peeks, and final state agree between engines in both modes."""
+    exd = make_ex(EXACT_AGGS, device=True, mode=mode)
+    exh = make_ex(EXACT_AGGS, device=False)
+    od, oh = [], []
+    for rows, ts in gen(seed):
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is not None and exd._dev["mode"] == mode
+    assert exd.device_fallbacks == 0
+    assert_rows_close(od, oh)
+    assert_rows_close(list(exd.peek()), list(exh.peek()))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_quantile_within_one_bucket(mode):
+    exd = make_ex(SKETCH_AGGS, device=True, mode=mode)
+    exh = make_ex(SKETCH_AGGS, device=False)
+    od, oh = [], []
+    for rows, ts in gen(7):
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is not None
+    # one-bucket tolerance: DDSketch bin edges are f32 on device
+    assert_rows_close(od, oh, rtol=0.08)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_cross_batch_session_extension(mode):
+    """A session extended across many batches (every batch within gap)
+    closes once, with the accumulated aggregates of all batches."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode, gap=1000, grace=0)
+    exh = make_ex(aggs, device=False, gap=1000, grace=0)
+    for b in range(6):
+        rows = [{"k": "a", "v": 1.0}]
+        for ex in (exd, exh):
+            out = ex.process(rows, [BASE + b * 900])
+            assert list(out) == []
+    closed_d, closed_h = None, None
+    for ex in (exd, exh):
+        out = ex.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+        rows = [r for r in out if r["k"] == "a"]
+        assert len(rows) == 1
+        if ex is exd:
+            closed_d = rows[0]
+        else:
+            closed_h = rows[0]
+    assert closed_d == closed_h
+    assert closed_d["c"] == 6 and closed_d["s"] == 6.0
+    assert closed_d["winStart"] == BASE
+    assert closed_d["winEnd"] == BASE + 5 * 900 + 1000
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_multi_session_merge_within_limit(mode):
+    """A batch bridging several open sessions of one key merges them
+    all (within chain_merge_limit) identically to the host. Grace keeps
+    the disjoint sessions open and the bridge records in-grace."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.MIN, "lo", input=Col("v")),
+            AggSpec(AggKind.MAX, "hi", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode, gap=100, grace=5000)
+    exh = make_ex(aggs, device=False, gap=100, grace=5000)
+    # 5 disjoint sessions (400ms apart >> gap), all open under grace
+    opens = [({"k": "a", "v": float(i)}, BASE + i * 400)
+             for i in range(5)]
+    for ex in (exd, exh):
+        for row, t in opens:
+            ex.process([row], [t])
+    assert len(list(exh.peek())) == 5
+    # one batch of bridge records every 80ms chains them all into ONE
+    bridge_ts = list(range(BASE + 50, BASE + 5 * 400, 80))
+    bridge = [{"k": "a", "v": 99.0} for _ in bridge_ts]
+    for ex in (exd, exh):
+        ex.process(bridge, bridge_ts)
+    assert exd.device_fallbacks == 0  # within the limit: no fallback
+    pd, ph = list(exd.peek()), list(exh.peek())
+    assert_rows_close(pd, ph)
+    assert len(pd) == 1 and pd[0]["c"] == 5 + len(bridge)
+    assert pd[0]["lo"] == 0.0 and pd[0]["hi"] == 99.0
+
+
+def test_chain_limit_triggers_host_fallback():
+    """A batch merging more open sessions than chain_merge_limit
+    degrades to the host engine — identical results, counted."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    exd = make_ex(aggs, device=True, mode="segment", gap=100,
+                  grace=5000)
+    exh = make_ex(aggs, device=False, gap=100, grace=5000)
+    exd.chain_merge_limit = 3
+    opens_ts = [BASE + i * 400 for i in range(6)]
+    for ex in (exd, exh):
+        for t in opens_ts:
+            ex.process([{"k": "a", "v": 1.0}], [t])
+    assert exd._dev is not None
+    bridge_ts = list(range(BASE + 50, BASE + 6 * 400, 80))
+    bridge = [{"k": "a", "v": 1.0} for _ in bridge_ts]
+    od = exd.process(bridge, bridge_ts)
+    oh = exh.process(bridge, bridge_ts)
+    assert exd._dev is None and exd.use_device_sessions is False
+    assert exd.device_fallbacks == 1
+    assert list(od) == list(oh)
+    # the degraded executor carries on, still host-identical
+    od = exd.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    oh = exh.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    assert_rows_close(od, oh)
+    assert exd.sessions.keys() == exh.sessions.keys()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_key_growth_and_code_compaction(mode):
+    """Key cardinality past the cache bound triggers the code-space
+    compaction (order-preserving remap kernel) instead of a cache
+    clear; results stay host-identical across the remap."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode, gap=500, grace=0)
+    exh = make_ex(aggs, device=False, gap=500, grace=0)
+    exd._KEY_CACHE_MAX = 64  # force compaction quickly
+    od, oh = [], []
+    rng = np.random.default_rng(3)
+    for b in range(8):
+        # fresh key names every batch: cardinality grows past the bound
+        ks = [f"k{b}_{int(i)}" for i in rng.integers(0, 40, 120)]
+        ts = (BASE + b * 5000 + rng.integers(0, 400, 120)).tolist()
+        rows = [{"k": k, "v": 1.0} for k in ks]
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is not None
+    assert exd.session_stats["remap_dispatches"] >= 1
+    assert_rows_close(od, oh)
+    assert_rows_close(list(exd.peek()), list(exh.peek()))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_snapshot_roundtrip_in_device_mode(mode):
+    """Snapshot taken while sessions are device-resident restores into
+    the host engine, re-activates lazily, and continues identically."""
+    from types import SimpleNamespace
+
+    from hstream_tpu.engine import snapshot as snap
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v")),
+            AggSpec(AggKind.APPROX_COUNT_DISTINCT, "d", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode)
+    exh = make_ex(aggs, device=False)
+    batches = gen(11, n_batches=5)
+    for rows, ts in batches[:3]:
+        exd.process(rows, ts)
+        exh.process(rows, ts)
+    assert exd._dev is not None
+    blob = snap.snapshot_executor(exd)
+    plan = SimpleNamespace(node=exd.node)  # restore only reads .node
+    restored, _extra = snap.restore_executor(plan, blob)
+    assert isinstance(restored, SessionExecutor)
+    assert restored._dev is None  # restores host-side
+    od, oh = [], []
+    for rows, ts in batches[3:]:
+        od.extend(restored.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert restored._dev is not None  # re-activated lazily
+    assert_rows_close(od, oh)
+    assert_rows_close(list(restored.peek()), list(exh.peek()))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_watermark_close_parity(mode):
+    """Sessions close at exactly wm >= end + 2*gap + grace on both
+    engines — no earlier, no later."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    gap, grace = 1000, 300
+    exd = make_ex(aggs, device=True, mode=mode, gap=gap, grace=grace)
+    exh = make_ex(aggs, device=False, gap=gap, grace=grace)
+    for ex in (exd, exh):
+        ex.process([{"k": "a", "v": 1.0}], [BASE])
+    # one below the close boundary: nothing closes
+    boundary = BASE + 2 * gap + grace
+    for ex in (exd, exh):
+        out = ex.process([{"k": "z", "v": 0.0}], [boundary - 1])
+        assert [r for r in out if r["k"] == "a"] == []
+    # at the boundary: closes on both
+    outs = []
+    for ex in (exd, exh):
+        out = ex.process([{"k": "z", "v": 0.0}], [boundary])
+        outs.append([r for r in out if r["k"] == "a"])
+    assert outs[0] == outs[1] and len(outs[0]) == 1
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_columnar_feed_equivalence(mode):
+    """process_columnar (the server's _session_columns feed shape)
+    matches the row path on both engines, nulls included."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode)
+    exh = make_ex(aggs, device=False)
+    rng = np.random.default_rng(5)
+    od, oh = [], []
+    for b in range(6):
+        n = 200
+        ks = np.array([f"u{int(i)}" for i in rng.integers(0, 10, n)])
+        vs = rng.integers(0, 100, n).astype(np.float32)
+        ts = BASE + b * 2500 + rng.integers(0, 4000, n)
+        nulls = {"v": rng.random(n) < 0.1}
+        od.extend(exd.process_columnar(ts, {"k": ks, "v": vs}, nulls))
+        rows = [({"k": str(k)} if isnull else
+                 {"k": str(k), "v": float(v)})
+                for k, v, isnull in zip(ks, vs, nulls["v"])]
+        oh.extend(exh.process(rows, ts.tolist()))
+    assert exd._dev is not None
+    assert_rows_close(od, oh)
+    assert_rows_close(list(exd.peek()), list(exh.peek()))
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_one_dispatch_zero_fetch_ingest_contract(mode):
+    """The session ingest contract: exactly ONE step dispatch per
+    micro-batch and ZERO fetches outside close cycles; each close cycle
+    is one extract dispatch + one fetch."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    ex = make_ex(aggs, device=True, mode=mode, gap=1000, grace=0)
+    rng = np.random.default_rng(9)
+    for b in range(10):
+        n = 256
+        rows = [{"k": f"u{int(i)}", "v": 1.0}
+                for i in rng.integers(0, 20, n)]
+        ts = (BASE + b * 10_000 + rng.integers(0, 900, n)).tolist()
+        ex.process(rows, ts)
+    st = ex.session_stats
+    assert st["step_dispatches"] == st["batches"]
+    assert st["close_dispatches"] == st["close_cycles"]
+    assert st["close_fetches"] == st["close_cycles"]
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_deferred_close_drain_single_stacked_fetch(mode):
+    """defer_close_decode holds packed closes as device values; one
+    drain fetches every same-shape cycle in a single stacked transfer
+    with rows identical to the synchronous path."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    exd = make_ex(aggs, device=True, mode=mode, gap=1000, grace=0)
+    exs = make_ex(aggs, device=True, mode=mode, gap=1000, grace=0)
+    exd.defer_close_decode = True
+    rng = np.random.default_rng(13)
+    sync_rows = []
+    for b in range(6):
+        n = 128
+        rows = [{"k": f"u{int(i)}", "v": 1.0}
+                for i in rng.integers(0, 8, n)]
+        ts = (BASE + b * 10_000 + rng.integers(0, 900, n)).tolist()
+        out = exd.process(rows, ts)
+        assert list(out) == []  # all emission deferred
+        sync_rows.extend(exs.process(rows, ts))
+    assert exd.has_pending_closes()
+    fetches_before = exd.session_stats["close_fetches"]
+    drained = list(exd.drain_closed())
+    # every same-shape cycle rode one stacked transfer
+    assert exd.session_stats["close_fetches"] - fetches_before \
+        <= len({tuple()})  # exactly one shape group here
+    assert_rows_close(drained, sync_rows)
+    assert not exd.has_pending_closes()
+
+
+def test_emit_changes_and_topk_refuse_device():
+    """Host-only configs never activate the device path (a refusal, not
+    a counted failure)."""
+    ex = make_ex([AggSpec(AggKind.COUNT_ALL, "c")], device=True,
+                 emit_changes=True)
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    assert ex._dev is None and ex._device_refusal is not None
+    assert ex.device_fallbacks == 0
+    ex2 = make_ex([AggSpec(AggKind.TOPK, "t", input=Col("v"), k=3)],
+                  device=True)
+    ex2.process([{"k": "a", "v": 1.0}], [BASE])
+    assert ex2._dev is None and "host-only" in ex2._device_refusal
+
+
+def test_host_emission_is_columnar():
+    """Satellite: peek() and close_due_sessions() ride ColumnarEmit on
+    the HOST engine too (sessions were the last per-row-dict emitter)."""
+    from hstream_tpu.common.columnar import ColumnarEmit
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    ex = make_ex(aggs, device=False)
+    ex.process([{"k": "a", "v": 1.0}, {"k": "b", "v": 2.0}],
+               [BASE, BASE + 10])
+    peeked = ex.peek()
+    assert isinstance(peeked, ColumnarEmit)
+    assert {r["k"] for r in peeked} == {"a", "b"}
+    out = ex.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    # the lone close batch stays columnar end-to-end (extend_rows)
+    assert isinstance(out, ColumnarEmit)
+    assert {r["k"] for r in out} == {"a", "b", "z"} - {"z"} or \
+        {r["k"] for r in out} <= {"a", "b", "z"}
+
+
+def test_device_emission_is_columnar():
+    from hstream_tpu.common.columnar import ColumnarEmit
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, device=True, gap=1000, grace=0)
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    assert isinstance(ex.peek(), ColumnarEmit)
+    out = ex.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    assert isinstance(out, ColumnarEmit)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_having_and_projections_parity(mode):
+    """HAVING + projections evaluate columnwise on both engines with
+    the same drop semantics."""
+    from hstream_tpu.engine.expr import BinOp, Lit
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c"),
+            AggSpec(AggKind.SUM, "s", input=Col("v"))]
+    having = BinOp(">", Col("c"), Lit(2))
+    projections = [("key", Col("k")), ("total", Col("s"))]
+    exd = make_ex(aggs, device=True, mode=mode, having=having,
+                  projections=projections)
+    exh = make_ex(aggs, device=False, having=having,
+                  projections=projections)
+    od, oh = [], []
+    for rows, ts in gen(17, n_batches=5):
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is not None
+    assert len(oh) > 0  # HAVING actually filtered a nonempty set
+    assert_rows_close(od, oh)
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_where_filter_parity(mode):
+    from hstream_tpu.engine.expr import BinOp, Lit
+    from hstream_tpu.engine.plan import FilterNode
+
+    schema = SCHEMA
+    pred = BinOp(">", Col("v"), Lit(100.0))
+    node = AggregateNode(
+        child=FilterNode(child=SourceNode("s", schema), predicate=pred),
+        group_keys=[Col("k")],
+        window=SessionWindow(1000, grace_ms=500),
+        aggs=[AggSpec(AggKind.COUNT_ALL, "c"),
+              AggSpec(AggKind.SUM, "s", input=Col("v"))])
+    exd = SessionExecutor(node, schema)
+    exd.device_session_mode = mode
+    exh = SessionExecutor(node, schema)
+    exh.use_device_sessions = False
+    od, oh = [], []
+    for rows, ts in gen(21, n_batches=6):
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is not None
+    assert_rows_close(od, oh)
+    # watermark advances on filtered-out records too (pre-filter max)
+    assert exd.watermark == exh.watermark
+
+
+def test_pinned_anchor_span_degrades_to_host_not_crash():
+    """Review finding (ISSUE 10): an ancient open session pins the
+    rebase anchor; once relative time reaches the device range the
+    executor must DEGRADE to the host engine (which has no int32
+    bound) instead of desyncing the mirror and crash-looping."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    # a ~9h grace keeps every session open across the whole run, so the
+    # FIRST session pins the rebase anchor at BASE while stream time
+    # advances 500s per batch past the (shrunk) relative range
+    exd = make_ex(aggs, device=True, mode="segment", gap=1000,
+                  grace=1 << 25)
+    exh = make_ex(aggs, device=False, gap=1000, grace=1 << 25)
+    exd.REBASE_THRESHOLD = 1 << 22  # ~70 min, keeps the test fast
+    od, oh = [], []
+    for b in range(12):
+        rows = [{"k": "pin", "v": 1.0},
+                {"k": f"s{b}", "v": 1.0}]
+        ts = [BASE + b * 500_000, BASE + b * 500_000 + 10]
+        od.extend(exd.process(rows, ts))
+        oh.extend(exh.process(rows, ts))
+    assert exd._dev is None and exd.device_fallbacks == 1
+    assert exd.use_device_sessions is False
+    assert_rows_close(od, oh)
+    assert_rows_close(list(exd.peek()), list(exh.peek()))
+
+
+def test_huge_gap_grace_refuses_device():
+    """2*gap + grace past the int32 relative budget is a plan-time
+    refusal (the close rule would not fit the device time range)."""
+    ex = make_ex([AggSpec(AggKind.COUNT_ALL, "c")], device=True,
+                 gap=1 << 29, grace=1 << 29)
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    assert ex._dev is None
+    assert "relative-time range" in ex._device_refusal
+    assert ex.device_fallbacks == 0
+
+
+def test_peek_does_not_skew_close_accounting():
+    """Review finding: pull-query peeks must not count into the
+    close-path dispatch/fetch budget the bench asserts on."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, device=True, gap=1000, grace=0)
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    for _ in range(3):
+        ex.peek()
+    st = ex.session_stats
+    assert st["peek_dispatches"] == 3
+    assert st["close_dispatches"] == st["close_cycles"]
+    assert st["close_fetches"] == st["close_cycles"]
+
+
+def test_snapshot_guard_requires_drained_closes():
+    """Deferred session closes block a snapshot until drained (the
+    packed device buffers are the only copy of those rows)."""
+    from hstream_tpu.common.errors import SQLCodegenError
+    from hstream_tpu.engine import snapshot as snap
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, device=True, gap=1000, grace=0)
+    ex.defer_close_decode = True
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    ex.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    assert ex.has_pending_closes()
+    with pytest.raises(SQLCodegenError, match="deferred session"):
+        snap.snapshot_executor(ex)
+    rows = ex.flush_changes()  # the task's pre-snapshot drain surface
+    assert [r["k"] for r in rows] == ["a"]
+    snap.snapshot_executor(ex)  # drained: snapshot proceeds
+
+
+def test_close_extract_dispatch_failure_degrades_not_dies():
+    """Review finding: a kernel failure at the close-extract DISPATCH
+    (mirror not yet retired) degrades to the host engine, which closes
+    the same due set — instead of killing the query."""
+    from hstream_tpu.common.faultinject import FAULTS
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    exd = make_ex(aggs, device=True, gap=1000, grace=0)
+    exh = make_ex(aggs, device=False, gap=1000, grace=0)
+    for ex in (exd, exh):
+        ex.process([{"k": "a", "v": 1.0}], [BASE])
+    try:
+        # hit 1 = the closer batch's step dispatch (passes), hit 2 =
+        # the close extract dispatch (fails)
+        FAULTS.arm("device.session.dispatch", "fail:2")
+        od = exd.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    finally:
+        FAULTS.disarm()
+    oh = exh.process([{"k": "z", "v": 0.0}], [BASE + 100_000])
+    assert exd.device_fallbacks == 1 and exd._dev is None
+    assert_rows_close(od, oh)
+    assert any(r["k"] == "a" for r in od)  # the close still emitted
+
+
+def test_peek_extract_dispatch_failure_degrades_not_dies():
+    from hstream_tpu.common.faultinject import FAULTS
+
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    exd = make_ex(aggs, device=True, gap=1000, grace=0)
+    exh = make_ex(aggs, device=False, gap=1000, grace=0)
+    for ex in (exd, exh):
+        ex.process([{"k": "a", "v": 1.0}], [BASE])
+    try:
+        FAULTS.arm("device.session.dispatch", "fail:1")
+        pd = list(exd.peek())
+    finally:
+        FAULTS.disarm()
+    assert exd.device_fallbacks == 1 and exd._dev is None
+    assert_rows_close(pd, list(exh.peek()))
+
+
+def test_degrade_with_pending_deferred_closes_keeps_keys():
+    """Review finding: pending deferred closes must resolve their key
+    columns AT degrade time — a later host-mode key-cache clear rebuilds
+    the code dictionary and lazy decode would read wrong keys."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    ex = make_ex(aggs, device=True, gap=1000, grace=0)
+    ex.defer_close_decode = True
+    ex.process([{"k": "a", "v": 1.0}], [BASE])
+    ex.process([{"k": "closer", "v": 0.0}], [BASE + 100_000])
+    assert ex.has_pending_closes()
+    ex._degrade_to_host("test: simulate a mid-stream device loss")
+    # host-mode cache bound clears the code dictionary wholesale
+    ex._KEY_CACHE_MAX = 0
+    ex.process([{"k": f"n{i}", "v": 1.0} for i in range(4)],
+               [BASE + 200_000 + i for i in range(4)])
+    rows = list(ex.drain_closed())
+    assert [r["k"] for r in rows] == ["a"]  # the ORIGINAL key survives
+
+
+def test_late_records_merge_into_open_sessions_on_device():
+    """A late record that overlaps an open session merges (not drops) —
+    the mirror's sequential late walk preserves the reference's
+    record-at-a-time drop-vs-merge decisions."""
+    aggs = [AggSpec(AggKind.COUNT_ALL, "c")]
+    for mode in MODES:
+        exd = make_ex(aggs, device=True, mode=mode, gap=1000, grace=0)
+        exh = make_ex(aggs, device=False, gap=1000, grace=0)
+        for ex in (exd, exh):
+            ex.process([{"k": "a", "v": 1.0}], [BASE + 10_000])
+            # late but overlapping "a"'s session: merges; late and far
+            # from any session: drops
+            ex.process(
+                [{"k": "a", "v": 1.0}, {"k": "a", "v": 1.0}],
+                [BASE + 9_500, BASE + 2_000])
+        pd, ph = list(exd.peek()), list(exh.peek())
+        assert pd == ph
+        assert pd[0]["c"] == 2  # merged one, dropped one

@@ -20,7 +20,12 @@ def make_ex(aggs, gap=1000, grace=500, emit_changes=False):
     node = AggregateNode(
         child=SourceNode("s", schema), group_keys=[Col("k")],
         window=SessionWindow(gap, grace_ms=grace), aggs=aggs)
-    return SessionExecutor(node, schema, emit_changes=emit_changes)
+    ex = SessionExecutor(node, schema, emit_changes=emit_changes)
+    # this file validates the HOST reference engine against the
+    # per-record oracle (it inspects ex.sessions directly); device/host
+    # equivalence has its own suite (tests/test_session_device.py)
+    ex.use_device_sessions = False
+    return ex
 
 
 def gen(seed, n_batches=8, batch=300, keys=12, late_frac=0.15):
@@ -132,6 +137,7 @@ def test_multi_column_group_key():
         window=SessionWindow(1000, grace_ms=0),
         aggs=[AggSpec(AggKind.SUM, "s", input=Col("v"))])
     ex = SessionExecutor(node, schema)
+    ex.use_device_sessions = False  # host engine: inspects ex.sessions
     rows = [{"k": "a", "r": 1, "v": 1.0}, {"k": "a", "r": 2, "v": 2.0},
             {"k": "a", "r": 1, "v": 3.0}]
     ex.process(rows, [BASE, BASE, BASE + 10])

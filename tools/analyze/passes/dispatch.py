@@ -76,6 +76,7 @@ HOT_PATH_FILES = (
     "hstream_tpu/engine/executor.py",
     "hstream_tpu/engine/join.py",
     "hstream_tpu/engine/pipeline.py",
+    "hstream_tpu/engine/session.py",
     "hstream_tpu/parallel/executor.py",
     "hstream_tpu/parallel/lattice.py",
 )
@@ -86,6 +87,8 @@ KERNEL_FACTORIES = {
     "join_probe_insert", "join_probe_only", "join_probe_insert_step",
     "join_evict", "compiled_encoded_step",
     "_count_close_kernel",
+    "session_step_kernel", "session_merge_kernel",
+    "session_extract_kernel", "session_remap_kernel",
 }
 # factories returning a NAMESPACE of kernels (attributes are kernels)
 KERNEL_NAMESPACE_FACTORIES = {"compiled", "ShardedLattice",

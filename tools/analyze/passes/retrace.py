@@ -69,6 +69,8 @@ _SANCTIONED_NAMES = {"_compile", "__init__", "compiled"}
 _KNOWN_FACTORIES = {
     "join_probe_insert", "join_probe_only", "join_probe_insert_step",
     "join_evict", "compiled_encoded_step", "compiled",
+    "session_step_kernel", "session_merge_kernel",
+    "session_extract_kernel", "session_remap_kernel",
 }
 
 _BATCHISH = ("batch", "batches", "rows", "codes", "kids", "matches",
