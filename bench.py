@@ -502,7 +502,12 @@ def bench_changelog_decode() -> dict:
 
 def bench_store_append(tmpdir: str) -> dict:
     """Native store append bench (the reference's writeBench.hs:29-60
-    analogue): records/s, MB/s, avg/p99 append latency."""
+    analogue): the SYNC fsync-per-call path (records/s, MB/s, avg/p99
+    append latency) AND the async completion-queue path (ISSUE 12 /
+    VERDICT weak #7: `append_async` existed unbenched while the ~93k
+    rec/s sync number was quoted as the store's ceiling) — submissions
+    pipeline into the C++ queue and group-commit, so the async number
+    is the one the sharded append front actually feeds."""
     import shutil
 
     from hstream_tpu.store import open_store
@@ -525,13 +530,35 @@ def bench_store_append(tmpdir: str) -> dict:
             lat.append(time.perf_counter() - t1)
         dt = time.perf_counter() - t0
         recs = n_batches * len(batch)
-        return {
+        out = {
             "records_per_sec": round(recs / dt),
             "mb_per_sec": round(recs * len(payload) / dt / 1e6, 1),
             "avg_append_ms": round(float(np.mean(lat)) * 1e3, 3),
             "p99_append_ms": round(float(np.percentile(lat, 99)) * 1e3,
                                    3),
         }
+        if hasattr(store, "append_async"):
+            for _ in range(20):  # completion-queue warmup
+                store.append_async(4242, batch).result(timeout=30)
+            futs = []
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                futs.append(store.append_async(4242, batch))
+            for f in futs:
+                f.result(timeout=60)
+            dt = time.perf_counter() - t0
+            out["records_per_sec_async"] = round(recs / dt)
+            out["mb_per_sec_async"] = round(
+                recs * len(payload) / dt / 1e6, 1)
+            out["async_vs_sync"] = round(
+                out["records_per_sec_async"]
+                / max(out["records_per_sec"], 1), 2)
+        else:
+            # mem:// fallback: same record shape, all-None async keys
+            out["records_per_sec_async"] = None
+            out["mb_per_sec_async"] = None
+            out["async_vs_sync"] = None
+        return out
     finally:
         store.close()
         shutil.rmtree(path, ignore_errors=True)
@@ -622,11 +649,18 @@ def bench_snapshot_overhead() -> dict:
 
 def server_path_eps() -> dict:
     """Measured Append -> push-query throughput through the REAL gRPC
-    server (loopback): the product path, not the library fast path.
-    Returns {"server_columnar_eps": ..., "server_json_eps": ...} —
-    columnar producer batches vs per-record JSON appends."""
+    server (loopback socket): the product path, not the library fast
+    path. Returns three ingest numbers —
+      server_columnar_eps     framed AppendColumnarStream micro-batches
+                              (THE guarded served-path metric, ISSUE 12)
+      server_columnar_pb_eps  the same batches as protobuf Append
+                              records (the legacy columnar path)
+      server_json_eps         per-record JSON appends
+    — plus per-stage append timings (decode/admit/handoff/store) from
+    the stage histograms and the append-front counters."""
     import grpc
 
+    from hstream_tpu.client.producer import encode_batch
     from hstream_tpu.common import records as rec
     from hstream_tpu.proto import api_pb2 as pb
     from hstream_tpu.proto.rpc import HStreamApiStub
@@ -660,37 +694,109 @@ def server_path_eps() -> dict:
                 time.sleep(0.02)
             raise TimeoutError("server path did not drain")
 
-        # columnar producer batches
+        # columnar batches, protobuf Append records (the legacy path)
         n, batches = 1 << 18, 12
         base = 1_700_000_000_000
         devs = np.array([f"d{k}" for k in range(N_KEYS)])
+
+        def mk_cols(b):
+            return {"device": devs[rng.integers(0, N_KEYS, n)],
+                    "temp": (np.rint(rng.normal(20, 5, n) * 10)
+                             .astype(np.float32) * np.float32(0.1))}
+
         payloads = []
-        for b in range(batches + 2):
+        for b in range(2):
             ts = base + b * 200 + np.sort(rng.integers(0, 200, n))
             payloads.append((int(ts[-1]), rec.build_columnar_record(
-                ts.astype(np.int64),
-                {"device": devs[rng.integers(0, N_KEYS, n)],
-                 "temp": (np.rint(rng.normal(20, 5, n) * 10)
-                          .astype(np.float32) * np.float32(0.1))})))
-        for last, p in payloads[:2]:  # warmup (compile)
+                ts.astype(np.int64), mk_cols(b))))
+        for last, p in payloads:  # warmup (compile)
             req = pb.AppendRequest(stream_name="bsrc")
             req.records.append(p)
             stub.Append(req)
         drain_to(payloads[1][0])
-        t0 = time.perf_counter()
-        for last, p in payloads[2:]:
-            req = pb.AppendRequest(stream_name="bsrc")
-            req.records.append(p)
-            stub.Append(req)
-        drain_to(payloads[-1][0])
-        out["server_columnar_eps"] = round(
-            batches * n / (time.perf_counter() - t0))
+
+        # columnar phases: the FRAMED fast path (ISSUE 12) — THE
+        # guarded served-path number, N micro-batches in ONE
+        # AppendColumnarStream call (bounds-check + handoff, no
+        # per-record protobuf) — vs the legacy protobuf-record path.
+        # Both are drain-bound at this batch size, so a single-shot
+        # phase is noise-dominated: best-of-2, INTERLEAVED, so neither
+        # path owns the warmer slot.
+        slot = [0]  # each phase takes a fresh ts window slot
+
+        def run_framed() -> int:
+            slot[0] += 1
+            fb = base + slot[0] * 10 * 60_000
+            frames = []
+            for b in range(batches + 2):
+                ts = fb + b * 200 + np.sort(rng.integers(0, 200, n))
+                frames.append((int(ts[-1]), encode_batch(
+                    ts.astype(np.int64), mk_cols(b))))
+            stub.AppendColumnarStream(iter(
+                [pb.AppendColumnarRequest(stream_name="bsrc",
+                                          blocks=[f])
+                 for _last, f in frames[:2]]))
+            drain_to(frames[1][0])
+            t0 = time.perf_counter()
+            resp = stub.AppendColumnarStream(iter(
+                [pb.AppendColumnarRequest(stream_name="bsrc",
+                                          blocks=[f])
+                 for _last, f in frames[2:]]))
+            drain_to(frames[-1][0])
+            eps = round(batches * n / (time.perf_counter() - t0))
+            assert resp.rows == batches * n
+            return eps
+
+        def run_pb() -> int:
+            slot[0] += 1
+            pbase = base + slot[0] * 10 * 60_000
+            payloads = []
+            for b in range(batches + 2):
+                ts = pbase + b * 200 + np.sort(rng.integers(0, 200, n))
+                payloads.append((int(ts[-1]), rec.build_columnar_record(
+                    ts.astype(np.int64), mk_cols(b))))
+            for last, p in payloads[:2]:
+                req = pb.AppendRequest(stream_name="bsrc")
+                req.records.append(p)
+                stub.Append(req)
+            drain_to(payloads[1][0])
+            t0 = time.perf_counter()
+            for last, p in payloads[2:]:
+                req = pb.AppendRequest(stream_name="bsrc")
+                req.records.append(p)
+                stub.Append(req)
+            drain_to(payloads[-1][0])
+            return round(batches * n / (time.perf_counter() - t0))
+
+        framed_runs = [run_framed()]
+        pb_runs = [run_pb()]
+        framed_runs.append(run_framed())
+        pb_runs.append(run_pb())
+        out["server_columnar_eps"] = max(framed_runs)
+        out["server_columnar_eps_runs"] = framed_runs
+        out["server_columnar_pb_eps"] = max(pb_runs)
+        out["server_columnar_pb_eps_runs"] = pb_runs
+        front = getattr(ctx, "append_front", None)
+        if front is not None:
+            out["append_front"] = front.stats()
+
+        def stage_pct(stage: str, q: float):
+            v = ctx.stats.histogram_percentile("stage_latency_ms",
+                                               stage, q)
+            return None if v is None else round(v, 3)
+
+        # profile-first (ISSUE 12): where the append milliseconds live
+        out["append_stages_ms"] = {
+            f"{s.removeprefix('append_')}_{q}": stage_pct(s, qq)
+            for s in ("append_decode", "append_admit",
+                      "append_handoff", "append_store")
+            for q, qq in (("p50", 50), ("p99", 99))}
 
         # per-record JSON appends (the reference-style path); warmup
         # compiles BOTH coalesced step shapes the timed phase can hit:
         # single-append polls (small cap) and burst coalesces (big cap)
         jn, jb, jwarm = 1000, 50, 10
-        base2 = base + 10 * 60_000
+        base2 = base + 60 * 60_000
         reqs = []
         for b in range(jb):
             req = pb.AppendRequest(stream_name="bsrc")
@@ -748,6 +854,31 @@ def server_path_eps() -> dict:
         server.stop(grace=1)
         ctx.shutdown()
     return out
+
+
+def _loopback_server_path() -> dict:
+    """Run `bench.py --loopback` in a subprocess pinned to the local
+    CPU backend and return its server-path metrics. A subprocess
+    because JAX's platform is fixed at first import — the parent may
+    already hold the tunneled accelerator."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--loopback"],
+        capture_output=True, text=True, timeout=900, env=env)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            d = json.loads(line)
+            for k in ("metric", "unit", "mode", "value"):
+                d.pop(k, None)
+            d["server_bench_platform"] = d.pop("platform", "cpu")
+            return d
+    raise RuntimeError(
+        f"loopback bench emitted no JSON (rc {proc.returncode}): "
+        f"{proc.stderr[-400:]}")
 
 
 def main() -> None:
@@ -915,7 +1046,17 @@ def main() -> None:
             print(f"# {label}: {time.perf_counter() - t0:.1f}s",
                   flush=True)
 
-    sp = safe("server_path", server_path_eps)
+    # the RECORDED server-path numbers are measured under --loopback in
+    # a subprocess pinned to the local CPU backend (ISSUE 12 satellite):
+    # the tunneled dev link swings >10x minute-to-minute (BENCH_r05 rtt
+    # 124.6ms), so guarding regressions on a tunneled measurement was
+    # noise — the link's cost stays visible separately as rtt_ms
+    sp = safe("server_path_loopback", _loopback_server_path)
+    if "error" in sp:
+        # loopback subprocess unavailable: fall back to in-process so
+        # the record is degraded, not absent (flagged by the key)
+        result["server_path_loopback_error"] = sp["error"]
+        sp = safe("server_path", server_path_eps)
     if "error" in sp:
         result["server_path_error"] = sp["error"]
     else:
@@ -1048,6 +1189,90 @@ def _smoke_session_config():
     return ex, feed, 20
 
 
+def _smoke_server_columnar(batches: int = 50) -> int:
+    """50-batch framed columnar-append SERVER run gating 0 steady-state
+    recompiles (ISSUE 12): the whole served path — AppendColumnarStream
+    -> frame door -> append front -> store -> query task -> staged
+    device step -> window close — must hit only shapes the warmup
+    compiled. Returns the XLA compile count over the steady batches."""
+    import grpc
+
+    from hstream_tpu.client.producer import encode_batch
+    from hstream_tpu.common.tracing import RetraceGuard
+    from hstream_tpu.proto import api_pb2 as pb
+    from hstream_tpu.proto.rpc import HStreamApiStub
+    from hstream_tpu.server.main import serve
+
+    server, ctx = serve("127.0.0.1", 0, "mem://")
+    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(ch)
+    try:
+        stub.CreateStream(pb.Stream(stream_name="smk"))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text="CREATE STREAM smkout AS SELECT device, "
+                      "COUNT(*) AS c, SUM(temp) AS s FROM smk "
+                      "GROUP BY device, TUMBLING (INTERVAL 1 SECOND) "
+                      "GRACE BY INTERVAL 0 SECOND;"))
+        deadline = time.time() + 30
+        task = None
+        while time.time() < deadline:
+            running = list(ctx.running_queries.values())
+            if running and running[0].attached.wait(0.05):
+                task = running[0]
+                break
+            time.sleep(0.01)
+        if task is None:
+            raise TimeoutError("smoke query never attached")
+        rng = np.random.default_rng(6)
+        n, warm = 512, 20
+        base = 1_700_000_000_000
+        devs = np.array([f"d{k}" for k in range(100)])
+        # cycled pre-generated batches, fixed ts template (the
+        # BatchSource pattern): stable wire combos -> stable shapes
+        uniq = [(devs[rng.integers(0, 100, n)],
+                 (np.rint(rng.normal(20, 5, n) * 10).astype(np.float32)
+                  * np.float32(0.1)))
+                for _ in range(4)]
+        ts_template = (np.arange(n, dtype=np.int64) * 200) // n
+
+        def frame(b):
+            dv, tp = uniq[b % 4]
+            ts = base + b * 200 + ts_template
+            return int(ts[-1]), encode_batch(ts, {"device": dv,
+                                                  "temp": tp})
+
+        def drain_to(target: int) -> None:
+            dl = time.time() + 60
+            while time.time() < dl:
+                ex = task.executor
+                if ex is not None and ex.watermark_abs >= target:
+                    return
+                time.sleep(0.01)
+            raise TimeoutError("server smoke did not drain")
+
+        def stream_batches(lo: int, hi: int):
+            reqs = [frame(b) for b in range(lo, hi)]
+            stub.AppendColumnarStream(iter(
+                [pb.AppendColumnarRequest(stream_name="smk",
+                                          blocks=[f])
+                 for _l, f in reqs]))
+            drain_to(reqs[-1][0])
+
+        for b in range(3):  # slow path first: one batch per poll
+            last, f = frame(b)
+            stub.AppendColumnar(pb.AppendColumnarRequest(
+                stream_name="smk", blocks=[f]))
+            drain_to(last)
+        stream_batches(3, warm)  # burst: spans window closes
+        with RetraceGuard() as g:
+            stream_batches(warm, warm + batches)
+        return g.count
+    finally:
+        ch.close()
+        server.stop(grace=1)
+        ctx.shutdown()
+
+
 def _smoke_run(config, batches: int = 50) -> int:
     """Warm one smoke config, then count XLA compiles over `batches`
     steady-state batches (contract: 0)."""
@@ -1083,18 +1308,20 @@ def smoke_main() -> None:
     tumbling = _smoke_run(_smoke_tumbling_config)
     join = _smoke_run(_smoke_join_config)
     session = _smoke_run(_smoke_session_config)
+    server_columnar = _smoke_server_columnar()
     result = {
         "metric": "recompiles_per_run",
         "mode": "smoke",
-        "value": tumbling + join + session,
+        "value": tumbling + join + session + server_columnar,
         "tumbling_recompiles": tumbling,
         "join_recompiles": join,
         "session_recompiles": session,
+        "server_columnar_recompiles": server_columnar,
         "batches": 50,
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))
-    if tumbling or join or session:
+    if tumbling or join or session or server_columnar:
         print("# retrace gate FAILED: steady-state batches compiled "
               "new XLA executables", flush=True)
         sys.exit(1)

@@ -19,6 +19,9 @@ from typing import Any, Iterable
 import grpc
 
 from hstream_tpu.client.retry import RetryPolicy
+from hstream_tpu.client.producer import ColumnarProducer  # noqa: F401
+# re-exported: the framed-append producer (ISSUE 12) lives beside the
+# SQL shell so `from hstream_tpu.client import ColumnarProducer` works
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.logger import REQUEST_ID_KEY
 from hstream_tpu.common.errors import SQLError

@@ -13,8 +13,16 @@ this is the TPU-first divergence SURVEY §7 prescribes ("protobuf decode
 + key dictionary off the critical path — columnar staging").
 
 Layout: MAGIC | u32 header_len | header JSON | ts i64[n] | col bytes...
-header: {"n": int, "cols": [[name, kind], ...], "dicts": {name: [str]}}
+        | null-mask bytes (u8[n] per masked column, ISSUE 12)...
+header: {"n": int, "cols": [[name, kind], ...], "dicts": {name: [str]},
+         "nulls": [name, ...]}        # optional; names masks in order
 kinds: "f32" | "i64" | "bool" | "str" (i32 ids into header dict)
+
+The optional per-column null masks carry missing/NULL cells on the
+wire (the framed append path's staging layout): a masked cell behaves
+exactly like a field a per-record producer never sent. Payloads
+without the "nulls" header key are the legacy layout — old producers
+and old decoders interoperate unchanged.
 """
 
 from __future__ import annotations
@@ -37,11 +45,15 @@ def is_columnar(payload: bytes) -> bool:
 
 def encode_columnar(ts_ms: np.ndarray,
                     cols: Mapping[str, np.ndarray | list],
-                    *, float_kind: str = "f32") -> bytes:
+                    *, float_kind: str = "f32",
+                    nulls: Mapping[str, np.ndarray] | None = None
+                    ) -> bytes:
     """Columns -> payload bytes. String columns (lists or object/str
     arrays) are dictionary-encoded; numeric arrays are cast to
     f32/i64/bool. float_kind="f64" keeps float columns at full double
-    precision (sink emission of host-finalized aggregates)."""
+    precision (sink emission of host-finalized aggregates). `nulls`
+    (name -> bool[n]) marks missing cells; masks ride after the column
+    bytes and decode back via decode_columnar_nulls."""
     ts = np.ascontiguousarray(ts_ms, np.int64)
     n = len(ts)
     meta_cols: list[list[str]] = []
@@ -67,8 +79,21 @@ def encode_columnar(ts_ms: np.ndarray,
             raise ValueError(f"column {name!r} length {len(data)} != {n}")
         meta_cols.append([name, kind])
         bufs.append(np.ascontiguousarray(data).tobytes())
-    header = json.dumps({"n": n, "cols": meta_cols, "dicts": dicts},
-                        separators=(",", ":")).encode()
+    meta = {"n": n, "cols": meta_cols, "dicts": dicts}
+    if nulls:
+        mask_names = []
+        for name, m in nulls.items():
+            if name not in cols:
+                raise ValueError(
+                    f"null mask for unknown column {name!r}")
+            m = np.asarray(m, np.bool_)
+            if len(m) != n:
+                raise ValueError(
+                    f"null mask {name!r} length {len(m)} != {n}")
+            mask_names.append(name)
+            bufs.append(np.ascontiguousarray(m, np.uint8).tobytes())
+        meta["nulls"] = mask_names
+    header = json.dumps(meta, separators=(",", ":")).encode()
     out = bytearray(MAGIC)
     out += np.uint32(len(header)).tobytes()
     out += header
@@ -77,11 +102,16 @@ def encode_columnar(ts_ms: np.ndarray,
     return bytes(out)
 
 
-def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
-    """payload -> (ts i64[n], {name: (kind, array, dict|None)}).
+def decode_columnar_nulls(payload) -> tuple[np.ndarray, dict[str, Any],
+                                            dict[str, np.ndarray] | None]:
+    """payload -> (ts i64[n], {name: (kind, array, dict|None)},
+    {name: bool[n]} | None).
 
-    Arrays are zero-copy views into the payload where alignment allows.
-    """
+    Arrays are zero-copy views into the payload where alignment allows;
+    accepts bytes or a memoryview (the framed append path hands the
+    frame's payload view straight in). Every declared size is checked
+    against the actual bytes BEFORE any array is built — a forged or
+    torn payload fails here, not deep inside the engine."""
     if not is_columnar(payload):
         raise ValueError("not a columnar payload")
     off = len(MAGIC)
@@ -89,7 +119,12 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
         raise ValueError("truncated columnar header")
     hlen = int(np.frombuffer(payload, np.uint32, 1, off)[0])
     off += 4
-    header = json.loads(payload[off: off + hlen])
+    if len(payload) - off < hlen:
+        raise ValueError("columnar header shorter than declared")
+    try:
+        header = json.loads(bytes(payload[off: off + hlen]))
+    except ValueError as e:
+        raise ValueError(f"bad columnar header JSON: {e}") from None
     off += hlen
     n = header["n"]
     # forged headers must fail HERE, not deep inside the engine: a
@@ -97,7 +132,12 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
     # over-read; both are rejected by explicit bounds checks
     if not isinstance(n, int) or n < 0:
         raise ValueError(f"bad columnar n={n!r}")
-    need = 8 * n
+    mask_names = header.get("nulls") or []
+    col_names = [name for name, _kind in header["cols"]]
+    if not isinstance(mask_names, list) \
+            or not set(mask_names) <= set(col_names):
+        raise ValueError("null masks name unknown columns")
+    need = 8 * n + len(mask_names) * n
     for _, kind in header["cols"]:
         if kind not in _KIND_DTYPE:
             raise ValueError(f"unknown column kind {kind!r}")
@@ -121,7 +161,45 @@ def decode_columnar(payload: bytes) -> tuple[np.ndarray, dict[str, Any]]:
                 raise ValueError(
                     f"string column {name!r} ids out of dict range")
         cols[name] = (kind, arr, d)
+    nulls: dict[str, np.ndarray] | None = None
+    if mask_names:
+        nulls = {}
+        for name in mask_names:
+            nulls[name] = np.frombuffer(payload, np.uint8, n,
+                                        off).astype(np.bool_)
+            off += n
+    if off != len(payload):
+        # exact-bounds contract: trailing undeclared bytes mean either
+        # a corrupt/forged block or a NEWER layout this decoder does
+        # not understand — refusing beats silently misreading it (an
+        # extension section ignored as junk could change row meaning,
+        # exactly what unread null masks would have done)
+        raise ValueError(
+            f"columnar payload longer than header claims "
+            f"({len(payload) - off} trailing bytes)")
+    return ts, cols, nulls
+
+
+def decode_columnar(payload) -> tuple[np.ndarray, dict[str, Any]]:
+    """Legacy 2-tuple decode (ts, cols) — null masks, if any, dropped;
+    null-aware consumers use decode_columnar_nulls."""
+    ts, cols, _nulls = decode_columnar_nulls(payload)
     return ts, cols
+
+
+def validate_block(payload) -> tuple[int, int]:
+    """Bounds-check one columnar block withOUT materializing a single
+    row: header sizes vs actual bytes, column kinds, string dict
+    ranges, null-mask coverage (all via the zero-copy decode). Returns
+    (n_rows, last_ts_ms). Raises ValueError on anything malformed —
+    the ingress door (colframe.open_block) maps that to the typed
+    INVALID_ARGUMENT refusal. Empty blocks are refused: an append of
+    zero rows is a producer bug, not a no-op."""
+    ts, _cols, _nulls = decode_columnar_nulls(payload)
+    n = int(len(ts))
+    if n == 0:
+        raise ValueError("empty columnar block (n=0)")
+    return n, int(ts[-1])
 
 
 def to_rows(ts: np.ndarray, cols: dict,
@@ -179,10 +257,12 @@ def payload_rows(payload: bytes) -> list[dict[str, Any]] | None:
     if not is_columnar(payload):
         return None
     try:
-        ts, cols = decode_columnar(payload)
+        ts, cols, nulls = decode_columnar_nulls(payload)
     except Exception:  # noqa: BLE001 — malformed payloads are skipped
         return None
-    return to_rows(ts, cols)
+    # drop_null: a masked cell is a field the producer never sent, so
+    # the row shape matches the per-record decode path
+    return to_rows(ts, cols, nulls, drop_null=True)
 
 
 class ColumnarEmit(Sequence):

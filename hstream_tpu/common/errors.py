@@ -117,6 +117,16 @@ class ServerError(HStreamError):
     pass
 
 
+class InvalidFrame(ServerError):
+    """A framed columnar append block failed validation at the ingress
+    door — bad magic/version, truncated or overlong body, CRC mismatch,
+    or an embedded columnar block whose declared sizes don't fit its
+    bytes. The refusal contract (ISSUE 12): typed INVALID_ARGUMENT
+    before ANY byte reaches the store, never a partial ingest."""
+
+    grpc_status = grpc.StatusCode.INVALID_ARGUMENT
+
+
 class SubscriptionNotFound(ServerError):
     grpc_status = grpc.StatusCode.NOT_FOUND
 

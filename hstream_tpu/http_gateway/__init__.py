@@ -22,6 +22,8 @@ Routes (JSON in/out unless noted):
   POST   /streams {"name": ...}       create
   DELETE /streams/<name>              delete
   POST   /streams/<name>/append {"records": [{...}]}   append JSON rows
+  POST   /streams/<name>/appendColumnar <raw frame>     framed columnar
+                                        block (octet-stream, ISSUE 12)
   GET    /queries | POST /queries {"sql": ...} | GET|DELETE /queries/<id>
   POST   /queries/<id>/restart
   GET    /views | GET /views/<name> (pull query) | DELETE /views/<name>
@@ -205,6 +207,20 @@ class Gateway:
                 return 200, {"record_ids": [
                     {"batch_id": r.batch_id, "batch_index": r.batch_index}
                     for r in resp.record_ids]}
+            m = re.fullmatch(r"/streams/([^/]+)/appendColumnar", path)
+            if m and method == "POST":
+                # raw framed columnar block (application/octet-stream):
+                # the HTTP face of the wire-speed append path — the
+                # gateway proxies the bytes untouched, the server's
+                # frame door does all validation (400 on a bad frame)
+                if not isinstance(body, (bytes, bytearray)) or not body:
+                    return 400, {"error": "body must be one framed "
+                                          "columnar block (raw bytes)"}
+                resp = stub.AppendColumnar(pb.AppendColumnarRequest(
+                    stream_name=m.group(1), blocks=[bytes(body)]))
+                return 200, {"rows": resp.rows, "record_ids": [
+                    {"batch_id": r.batch_id, "batch_index": r.batch_index}
+                    for r in resp.record_ids]}
 
             if path == "/queries" and method == "GET":
                 out = stub.ListQueries(pb.ListQueriesRequest())
@@ -362,22 +378,28 @@ def _make_handler(gw: Gateway):
         def _run(self, method: str) -> None:
             from urllib.parse import unquote, urlsplit
 
+            # split query string, decode %-escapes in resource names
+            # (before the body read: the framed-append route takes its
+            # body RAW, everything else parses JSON)
+            parts = urlsplit(self.path)
+            path = unquote(parts.path)
             body = None
             length = int(self.headers.get("Content-Length") or 0)
             if length:
-                try:
-                    body = json.loads(self.rfile.read(length))
-                except ValueError:
-                    self._send(400, {"error": "invalid JSON body"})
-                    return
+                raw = self.rfile.read(length)
+                if path.rstrip("/").endswith("/appendColumnar"):
+                    body = raw  # one framed columnar block, raw bytes
+                else:
+                    try:
+                        body = json.loads(raw)
+                    except ValueError:
+                        self._send(400, {"error": "invalid JSON body"})
+                        return
             # correlation: honor the caller's id, mint one otherwise;
             # the id rides the proxied gRPC metadata and echoes back
             rid = (self.headers.get("X-Request-Id")
                    or f"gw-{uuid.uuid4().hex[:12]}")
             self._rid = rid
-            # split query string, decode %-escapes in resource names
-            parts = urlsplit(self.path)
-            path = unquote(parts.path)
             with request_context(rid):
                 out = gw.handle(method, path.rstrip("/") or path, body,
                                 query=parts.query)
@@ -449,6 +471,9 @@ SWAGGER = {
         "/streams/{name}": {"delete": {"summary": "delete stream"}},
         "/streams/{name}/append": {
             "post": {"summary": "append JSON records"}},
+        "/streams/{name}/appendColumnar": {
+            "post": {"summary": "append one framed columnar block "
+                                "(raw bytes, colframe wire format)"}},
         "/queries": {"get": {"summary": "list queries"},
                      "post": {"summary": "create push query"}},
         "/queries/{id}": {"get": {"summary": "get query"},

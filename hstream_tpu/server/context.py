@@ -26,6 +26,9 @@ from hstream_tpu.store.streams import StreamApi
 # QueryTask fallbacks) imports these so they cannot drift
 DEFAULT_PIPELINE_DEPTH = 4
 DEFAULT_ENCODE_WORKERS = 2
+# append-front lanes behind the framed columnar append path (ignored
+# on stores with their own completion queue — see server/appendfront)
+DEFAULT_APPEND_LANES = 2
 
 
 class ServerContext:
@@ -37,7 +40,8 @@ class ServerContext:
                  pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
                  encode_workers: int = DEFAULT_ENCODE_WORKERS,
                  credit_window: int | None = None,
-                 slow_request_ms: float = 1000.0):
+                 slow_request_ms: float = 1000.0,
+                 append_lanes: int = DEFAULT_APPEND_LANES):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
@@ -100,6 +104,13 @@ class ServerContext:
         # their lookup+append+record through this lock (the replicated
         # store has its own critical section; store/dedup.py)
         self.dedup_lock = threading.Lock()
+        # wire-speed ingest (ISSUE 12): framed columnar appends go
+        # through sharded lanes feeding the store's completion-queue
+        # path, so the RPC thread validates the NEXT block while the
+        # previous one fsyncs
+        from hstream_tpu.server.appendfront import AppendFront
+
+        self.append_front = AppendFront(store, lanes=append_lanes)
         # CAS-versioned cluster config (reference VersionedConfigStore);
         # first consumer: the boot-epoch counter below — each server
         # boot on a store CAS-increments it, so concurrent servers on
@@ -190,4 +201,10 @@ class ServerContext:
                 pass
         for rt in self.subscriptions.list():
             rt.shutdown()
+        front = getattr(self, "append_front", None)
+        if front is not None:
+            # drain the append lanes BEFORE the store closes: a lane
+            # worker mid-append against a closed store would fail an
+            # acknowledged-in-flight batch
+            front.close()
         self.store.close()

@@ -1,4 +1,6 @@
-"""The HStreamApi handler table: all 35 RPCs.
+"""The HStreamApi handler table: the reference's 35 RPCs plus the
+framed columnar append pair (AppendColumnar / AppendColumnarStream,
+ISSUE 12).
 
 Reference: `handlers` wires the full service (Handler.hs:96-174); stream
 CRUD + append at Handler.hs:187-231; `executeQueryHandler` dispatches
@@ -19,7 +21,7 @@ from typing import Any, Iterable
 import grpc
 from google.protobuf import empty_pb2, struct_pb2
 
-from hstream_tpu.common import columnar
+from hstream_tpu.common import colframe, columnar
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.errors import (
     HStreamError,
@@ -94,9 +96,19 @@ def _abort_hstream(context, e: HStreamError) -> None:
 # request (stream for data-plane RPCs, leading keyword for SQL)
 _RPC_HISTOGRAMS = {
     "Append": "append_latency_ms",
+    "AppendColumnar": "append_latency_ms",
+    # AppendColumnarStream observes its own latency inside the handler:
+    # _finish_rpc only sees the request ITERATOR, which carries no
+    # stream name for the label
     "Fetch": "fetch_latency_ms",
     "ExecuteQuery": "sql_execute_latency_ms",
 }
+
+# profile-first discipline (ISSUE 12): the framed append path reports
+# where its milliseconds live, per stage, into the stage histograms —
+# frame/block validation, flow admission, lane handoff, store wait
+APPEND_STAGES = ("append_decode", "append_admit", "append_handoff",
+                 "append_store")
 
 
 def _request_id_from(context) -> str:
@@ -287,9 +299,11 @@ class HStreamApiServicer:
         payloads = []
         nbytes = 0
         for r in request.records:
-            if not r.header.publish_time_ms:
-                r.header.publish_time_ms = now
-            data = r.SerializeToString()
+            # ISSUE 12 satellite: the batch default timestamp is
+            # stamped once (only into headers that carry none), and
+            # large payloads are spliced around a header-only
+            # serialize instead of re-walked whole (records.py)
+            data = rec.record_bytes(r, default_ts=now)
             payloads.append(data)
             nbytes += len(data)
         if not payloads:
@@ -329,6 +343,187 @@ class HStreamApiServicer:
                                 duplicate=dup)
         for i in range(n):
             out.record_ids.append(pb.RecordId(batch_id=lsn, batch_index=i))
+        return out
+
+    # ---- framed columnar append (ISSUE 12 tentpole) -------------------------
+
+    def _observe_append_stage(self, stage: str, seconds: float) -> None:
+        try:
+            self.ctx.stats.observe("stage_latency_ms", stage,
+                                   seconds * 1e3)
+        except Exception:  # noqa: BLE001 — metrics must not fail RPCs
+            pass
+
+    # contract: dispatches<=0 fetches<=0
+    def _append_blocks(self, stream: str, blocks
+                       ) -> tuple["object", int, int, int]:
+        """Validate-ALL-then-submit for one request's framed blocks:
+        every frame is opened and its columnar block bounds-checked
+        BEFORE any byte is handed to the append front, and the whole
+        request goes to the store as ONE batch (like the protobuf
+        Append path) — so neither a bad frame NOR a store failure can
+        partially ingest a request. Returns (future, n_blocks, rows,
+        nbytes); the future resolves to the request's shared LSN
+        (blocks are addressed (lsn, block_index))."""
+        ctx = self.ctx
+        logid = ctx.streams.get_logid(stream)
+        if not blocks:
+            raise ServerError("empty append")
+        t0 = time.perf_counter()
+        wraps: list[bytes] = []
+        rows = 0
+        nbytes = 0
+        for b in blocks:
+            payload, n, last_ts = colframe.open_block(b)
+            # the store sees NORMAL columnar records: one header
+            # serialize + one memcpy each (no protobuf round-trip),
+            # read side unchanged
+            wraps.append(rec.wrap_raw_record(payload, last_ts))
+            rows += n
+            nbytes += len(b)
+        t1 = time.perf_counter()
+        if ctx.flow.active:
+            ctx.flow.admit_append(stream, rows, nbytes)
+        t2 = time.perf_counter()
+        # honor the operator's storage-compression knob like the
+        # protobuf Append path does
+        compression = getattr(ctx, "append_compression",
+                              Compression.NONE)
+        fut = ctx.append_front.submit(logid, wraps, compression)
+        t3 = time.perf_counter()
+        self._observe_append_stage("append_decode", t1 - t0)
+        self._observe_append_stage("append_admit", t2 - t1)
+        self._observe_append_stage("append_handoff", t3 - t2)
+        return fut, len(wraps), rows, nbytes
+
+    def _settle_appends(self, stream: str, entries: list
+                        ) -> tuple[list[tuple[int, int]], int, int, int,
+                                   BaseException | None]:
+        """Wait out EVERY submitted request batch (never abandon a
+        future — an unretrieved exception is log noise and an
+        uncounted store mutation): returns (record ids as (lsn, idx),
+        landed_blocks, landed_rows, landed_bytes, first_error).
+        Failures count append_failed."""
+        t0 = time.perf_counter()
+        ids: list[tuple[int, int]] = []
+        blocks = rows = nbytes = 0
+        err: BaseException | None = None
+        for fut, nblocks, r, nb in entries:
+            try:
+                lsn = fut.result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — surfaced after
+                # every sibling batch settles
+                self.ctx.stats.stream_stat_add("append_failed", stream)
+                if err is None:
+                    err = e
+            else:
+                ids.extend((lsn, i) for i in range(nblocks))
+                blocks += nblocks
+                rows += r
+                nbytes += nb
+        self._observe_append_stage("append_store",
+                                   time.perf_counter() - t0)
+        return ids, blocks, rows, nbytes, err
+
+    def _note_landed(self, stream: str, blocks: int, rows: int,
+                     nbytes: int) -> None:
+        """Metrics for blocks that durably landed — recorded even when
+        the RPC itself aborts, so counters never undercount the store."""
+        if blocks:
+            self.ctx.stats.note_append(stream, blocks, nbytes)
+            self.ctx.stats.stream_stat_add("append_columnar_rows",
+                                           stream, rows)
+
+    @unary
+    def AppendColumnar(self, request, context):
+        """Framed columnar append: bounds-check + handoff, no
+        per-record protobuf work (the staging layout the encode
+        workers consume arrives AS the wire format)."""
+        stream = request.stream_name
+        entry = self._append_blocks(stream, request.blocks)
+        ids, blocks, rows, nbytes, err = self._settle_appends(stream,
+                                                              [entry])
+        self._note_landed(stream, blocks, rows, nbytes)
+        if err is not None:
+            raise err
+        out = pb.AppendColumnarResponse(stream_name=stream, rows=rows)
+        for lsn, idx in ids:
+            out.record_ids.append(pb.RecordId(batch_id=lsn,
+                                              batch_index=idx))
+        return out
+
+    @unary
+    def AppendColumnarStream(self, request_iterator, context):
+        """Client-streaming framed append: N micro-batches amortize ONE
+        RPC. Each request message is validated atomically and its
+        blocks submitted to the append front, overlapping the next
+        message's receive with the previous blocks' store wait; the
+        single response carries every block's record id in submission
+        order. A bad frame aborts the call — its own request's blocks
+        never land; EARLIER requests were already durably appended
+        (their rows stay counted, and their ids would have been acked
+        had the stream completed)."""
+        ctx = self.ctx
+        t_rpc = time.perf_counter()
+        stream = None
+        pending: list = []    # one (future, blocks, rows, bytes)/request
+        ids: list[tuple[int, int]] = []
+        landed = [0, 0, 0]           # blocks, rows, bytes
+
+        def settle(limit: int) -> None:
+            while len(pending) > limit:
+                got, b, r, nb, err = self._settle_appends(
+                    stream, [pending.pop(0)])
+                ids.extend(got)
+                landed[0] += b
+                landed[1] += r
+                landed[2] += nb
+                if err is not None:
+                    raise err
+
+        try:
+            for req in request_iterator:
+                if stream is None:
+                    stream = req.stream_name
+                    if not stream:
+                        raise ServerError(
+                            "first AppendColumnarStream request must "
+                            "name the stream")
+                elif req.stream_name and req.stream_name != stream:
+                    raise ServerError(
+                        "AppendColumnarStream carries ONE stream per "
+                        f"call; got {req.stream_name!r} after "
+                        f"{stream!r}")
+                pending.append(self._append_blocks(stream, req.blocks))
+                # bound in-flight memory without stalling the pipeline
+                settle(128)
+            if stream is None:
+                raise ServerError("empty append stream")
+            settle(0)
+        finally:
+            # aborting or not, every submitted request settles: what
+            # durably landed is counted, no future is abandoned
+            if pending and stream is not None:
+                got, b, r, nb, _err = self._settle_appends(stream,
+                                                           pending)
+                ids.extend(got)
+                landed[0] += b
+                landed[1] += r
+                landed[2] += nb
+            if stream is not None:
+                self._note_landed(stream, *landed)
+        try:
+            # whole-call latency under the STREAM label (see the
+            # _RPC_HISTOGRAMS note)
+            ctx.stats.observe("append_latency_ms", stream,
+                              (time.perf_counter() - t_rpc) * 1e3)
+        except Exception:  # noqa: BLE001 — metrics must not fail RPCs
+            pass
+        out = pb.AppendColumnarResponse(stream_name=stream,
+                                        rows=landed[1])
+        for lsn, idx in ids:
+            out.record_ids.append(pb.RecordId(batch_id=lsn,
+                                              batch_index=idx))
         return out
 
     @unary

@@ -18,6 +18,7 @@ import grpc
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.proto.rpc import add_hstream_api_to_server
 from hstream_tpu.server.context import (
+    DEFAULT_APPEND_LANES,
     DEFAULT_ENCODE_WORKERS,
     DEFAULT_PIPELINE_DEPTH,
     ServerContext,
@@ -48,6 +49,7 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           append_compression: str | None = None,
           pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
           encode_workers: int = DEFAULT_ENCODE_WORKERS,
+          append_lanes: int = DEFAULT_APPEND_LANES,
           credit_window: int | None = None,
           metrics_port: int | None = None,
           slow_request_ms: float = 1000.0,
@@ -82,7 +84,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         pipeline_depth=pipeline_depth,
                         encode_workers=encode_workers,
                         credit_window=credit_window,
-                        slow_request_ms=slow_request_ms)
+                        slow_request_ms=slow_request_ms,
+                        append_lanes=append_lanes)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
         # HSTREAM_FAULTS, which ServerContext already loaded)
@@ -178,6 +181,11 @@ def _parse_args(argv):
                     help="host-encode worker threads per query task "
                          "feeding the staging ring (default "
                          f"{DEFAULT_ENCODE_WORKERS})")
+    ap.add_argument("--append-lanes", type=int, default=None,
+                    help="sharded append-front lanes behind the framed "
+                         "columnar append path (stores with a native "
+                         "completion queue pipeline there instead; "
+                         f"default {DEFAULT_APPEND_LANES})")
     ap.add_argument("--credit-window", type=int, default=None,
                     help="per-consumer in-flight record window for "
                          "push delivery (StreamingFetch); a stalled "
@@ -206,6 +214,7 @@ def _parse_args(argv):
                 "append_compression": None,
                 "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
                 "encode_workers": DEFAULT_ENCODE_WORKERS,
+                "append_lanes": DEFAULT_APPEND_LANES,
                 "credit_window": None,
                 "metrics_port": None,
                 "slow_request_ms": 1000.0,
@@ -248,6 +257,7 @@ def main(argv=None) -> None:
         append_compression=cfg["append_compression"],
         pipeline_depth=cfg["pipeline_depth"],
         encode_workers=cfg["encode_workers"],
+        append_lanes=cfg["append_lanes"],
         credit_window=cfg["credit_window"],
         metrics_port=cfg["metrics_port"],
         slow_request_ms=cfg["slow_request_ms"],

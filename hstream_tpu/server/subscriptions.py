@@ -59,8 +59,10 @@ def _expand_columnar(payload: bytes) -> list[bytes] | None:
         if (r.header.flag != rec.pb.RECORD_FLAG_RAW
                 or not columnar.is_columnar(r.payload)):
             return None
-        ts, cols = columnar.decode_columnar(r.payload)
-        rows = columnar.to_rows(ts, cols)
+        ts, cols, nulls = columnar.decode_columnar_nulls(r.payload)
+        # drop_null: masked cells (framed-append null masks) read as
+        # fields the producer never sent, like every other consumer
+        rows = columnar.to_rows(ts, cols, nulls, drop_null=True)
     except Exception:  # noqa: BLE001 — malformed: deliver verbatim
         return None
     if not rows:

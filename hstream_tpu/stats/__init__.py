@@ -54,6 +54,9 @@ PER_STREAM_COUNTERS = [
     "append_deduped",          # producer-stamped appends answered from
                                # the dedup window (retry landed exactly
                                # once; label: stream)
+    "append_columnar_rows",    # rows ingested through the framed
+                               # columnar append path (bounds-check +
+                               # handoff, no per-record protobuf)
 ]
 
 PER_STREAM_TIME_SERIES = [

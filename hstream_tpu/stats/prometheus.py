@@ -67,6 +67,8 @@ _HELP = {
                       "was fenced by a higher epoch",
     "append_deduped": "producer-stamped appends answered from the "
                       "dedup window (retries landed exactly once)",
+    "append_columnar_rows": "rows ingested through the framed columnar "
+                            "append path",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",

@@ -832,9 +832,11 @@ class ReplicatedStore(LogStore):
     # local log+apply happens inline (cheap), but the follower-ack wait
     # moves to a pool thread so the caller keeps its bounded-in-flight
     # pipelining instead of serializing on a DCN round trip per batch
-    def append_async(self, logid: int, payloads: Sequence[bytes]):
+    def append_async(self, logid: int, payloads: Sequence[bytes],
+                     compression: Compression = Compression.NONE):
         entry = pb.LogEntry(op=pb.OP_APPEND, logid=logid,
-                            payloads=[bytes(p) for p in payloads])
+                            payloads=[bytes(p) for p in payloads],
+                            compression=compression.value)
         seq = self._log_and_apply(entry)
         lsn = entry.expect_lsn
 

@@ -779,6 +779,29 @@ def test_dispatch_shape_group_stacking_and_branches_clean():
     assert run_one(dispatch, [src("m.py", code)]) == []
 
 
+def test_dispatch_append_path_is_policed():
+    """ISSUE 12: the framed append path is registered hot — it is
+    host-only BY CONTRACT (dispatches<=0 fetches<=0), so a device sync
+    creeping into the ingress door is flagged, bare or budgeted."""
+    bare = '''
+    import numpy as np
+
+    class AppendFront:
+        def submit(self, logid, payloads):
+            return np.asarray(self.state)
+    '''
+    out = run_one(dispatch,
+                  [src("hstream_tpu/server/appendfront.py", bare)])
+    assert len(out) == 1 and out[0].rule == "dispatch-sync"
+    budgeted = bare.replace(
+        "        def submit(self, logid, payloads):",
+        "        # contract: dispatches<=0 fetches<=0\n"
+        "        def submit(self, logid, payloads):")
+    out = run_one(dispatch,
+                  [src("hstream_tpu/common/colframe.py", budgeted)])
+    assert len(out) == 1 and out[0].rule == "dispatch-budget"
+
+
 def test_dispatch_sync_in_hot_path_flagged_and_contract_exempts():
     bare = '''
     import numpy as np

@@ -79,6 +79,11 @@ HOT_PATH_FILES = (
     "hstream_tpu/engine/session.py",
     "hstream_tpu/parallel/executor.py",
     "hstream_tpu/parallel/lattice.py",
+    # the framed append path (ISSUE 12): host-only by contract — its
+    # hot functions declare dispatches<=0 fetches<=0, and any device
+    # sync creeping into the ingest door is a regression
+    "hstream_tpu/common/colframe.py",
+    "hstream_tpu/server/appendfront.py",
 )
 
 # factories whose RESULT is a compiled kernel callable

@@ -30,7 +30,9 @@ PB2 = os.path.join(REPO, "hstream_tpu", "proto", "api_pb2.py")
 
 T = dpb.FieldDescriptorProto
 
-# message -> [(name, number, type)] appended if absent (proto3 singular)
+# message -> [(name, number, type[, label[, type_name]])] appended if
+# absent (proto3 singular unless label says otherwise; type_name names
+# the message type for TYPE_MESSAGE fields, package-qualified)
 NEW_FIELDS = {
     "AppendRequest": [
         # idempotent producers (ISSUE 9): a client that stamps a
@@ -73,8 +75,23 @@ NEW_FIELDS = {
     ],
 }
 
-# new top-level messages: name -> [(field, number, type)]
+# new top-level messages: name -> [(field, number, type, ...)]
 NEW_MESSAGES = {
+    # Wire-speed ingest (ISSUE 12): each block is one FRAMED columnar
+    # micro-batch (common/colframe.py) — the exact staging layout the
+    # encode workers consume; the server bounds-checks and hands off,
+    # no per-record protobuf parse/serialize on the append path.
+    "AppendColumnarRequest": [
+        ("stream_name", 1, T.TYPE_STRING),
+        ("blocks", 2, T.TYPE_BYTES, T.LABEL_REPEATED),
+    ],
+    "AppendColumnarResponse": [
+        ("stream_name", 1, T.TYPE_STRING),
+        # one record id per block, in submission order
+        ("record_ids", 2, T.TYPE_MESSAGE, T.LABEL_REPEATED,
+         ".hstream.tpu.RecordId"),
+        ("rows", 3, T.TYPE_UINT64),
+    ],
     "PromoteRequest": [
         ("epoch", 1, T.TYPE_UINT64),
         ("leader_addr", 2, T.TYPE_STRING),
@@ -88,8 +105,17 @@ NEW_MESSAGES = {
     ],
 }
 
-# service -> [(method, input message, output message)]
+# service -> [(method, input msg, output msg[, client_streaming])]
 NEW_METHODS = {
+    "HStreamApi": [
+        # Wire-speed ingest (ISSUE 12): unary for one-shot producers,
+        # client-streaming so N micro-batches amortize ONE RPC (the
+        # per-call gRPC overhead co-located producers were paying)
+        ("AppendColumnar", "AppendColumnarRequest",
+         "AppendColumnarResponse"),
+        ("AppendColumnarStream", "AppendColumnarRequest",
+         "AppendColumnarResponse", True),
+    ],
     "StoreReplica": [
         ("Promote", "PromoteRequest", "PromoteResponse"),
     ],
@@ -112,7 +138,8 @@ def patch(blob: bytes) -> tuple[bytes, int]:
     msgs = {m.name: m for m in fdp.message_type}
     edits = 0
 
-    def add_field(msg, name, number, ftype):
+    def add_field(msg, name, number, ftype,
+                  label=T.LABEL_OPTIONAL, type_name=None):
         nonlocal edits
         if any(f.name == name for f in msg.field):
             return
@@ -120,14 +147,16 @@ def patch(blob: bytes) -> tuple[bytes, int]:
         f.name = name
         f.number = number
         f.type = ftype
-        f.label = T.LABEL_OPTIONAL
+        f.label = label
+        if type_name is not None:
+            f.type_name = type_name
         parts = name.split("_")
         f.json_name = parts[0] + "".join(p.title() for p in parts[1:])
         edits += 1
 
     for mname, fields in NEW_FIELDS.items():
-        for name, number, ftype in fields:
-            add_field(msgs[mname], name, number, ftype)
+        for spec in fields:
+            add_field(msgs[mname], *spec)
     for mname, fields in NEW_MESSAGES.items():
         if mname in msgs:
             msg = msgs[mname]
@@ -136,17 +165,20 @@ def patch(blob: bytes) -> tuple[bytes, int]:
             msg.name = mname
             msgs[mname] = msg
             edits += 1
-        for name, number, ftype in fields:
-            add_field(msg, name, number, ftype)
+        for spec in fields:
+            add_field(msg, *spec)
     for sname, methods in NEW_METHODS.items():
         svc = next(s for s in fdp.service if s.name == sname)
-        for name, in_m, out_m in methods:
+        for spec in methods:
+            name, in_m, out_m = spec[:3]
             if any(m.name == name for m in svc.method):
                 continue
             m = svc.method.add()
             m.name = name
             m.input_type = PKG + in_m
             m.output_type = PKG + out_m
+            if len(spec) > 3 and spec[3]:
+                m.client_streaming = True
             edits += 1
     return fdp.SerializeToString(), edits
 
