@@ -188,6 +188,46 @@ def measure_close_latency(ex, pipe, src, n_samples: int = 32) -> tuple:
     return samples, dispatch
 
 
+def measure_freshness(feed, drain, batches: int) -> dict:
+    """End-to-end freshness of the ENGINE path (ISSUE 13): for each
+    steady-state batch, wall time from the batch's submission to its
+    triggered emissions decoded on host — split into dispatch (the
+    feed/step call) and drain (deferred close/changelog fetch+decode).
+    Only batches that produced emissions sample; p50/p99 over those.
+    The served path's freshness comes from the server's own
+    freshness histograms instead (server_path_eps)."""
+    total: list[float] = []
+    disp: list[float] = []
+    dr: list[float] = []
+    for b in range(batches):
+        t0 = time.perf_counter()
+        out = feed(b)
+        t1 = time.perf_counter()
+        rows = drain()
+        t2 = time.perf_counter()
+        emitted = (out is not None and len(out)) or \
+            (rows is not None and len(rows))
+        if emitted:
+            total.append((t2 - t0) * 1e3)
+            disp.append((t1 - t0) * 1e3)
+            dr.append((t2 - t1) * 1e3)
+    if not total:
+        return {"samples": 0}
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 3)
+
+    return {
+        "samples": len(total),
+        "p50": pct(total, 50),
+        "p99": pct(total, 99),
+        "stages_ms": {
+            "dispatch_p50": pct(disp, 50), "dispatch_p99": pct(disp, 99),
+            "drain_p50": pct(dr, 50), "drain_p99": pct(dr, 99),
+        },
+    }
+
+
 @functools.lru_cache(maxsize=1)
 def _rtt_step():
     """Memoized ping kernel: the jit used to be built inside
@@ -339,6 +379,11 @@ def bench_config4_session_quantile() -> dict:
     best["device_mode"] = (ex._dev or {}).get("mode")
     best["host_fallbacks"] = ex.device_fallbacks
     best["session_stats"] = dict(ex.session_stats)
+    # end-to-end freshness (ISSUE 13): submit -> emitted session rows,
+    # dispatch/drain split (stride > 2*gap, so every batch closes the
+    # prior sessions — each batch samples)
+    best["freshness_ms"] = measure_freshness(
+        lambda b: feed(ex, b0 + b), ex.drain_closed, 20)
     # the retained host engine on the same feed, for the r05 lineage
     # (3 batches only — it is ~10x slower; scaled to eps)
     exh = _session_quantile_executor()
@@ -445,6 +490,17 @@ def bench_config5_join_view() -> dict:
         if best is None or res["events_per_sec"] > best["events_per_sec"]:
             best = res
     best["join_stats"] = dict(getattr(ex, "join_stats", {}))
+
+    # end-to-end freshness (ISSUE 13): submit -> changelog rows
+    # decoded, dispatch/drain split (flush forces the deferred match
+    # and change extracts per sample)
+    def _join_feed(b):
+        kk, ts = mk(b0 + b)
+        return ex.process_columnar(ts, {"k": kk, "x": xcol},
+                                   stream="l" if b % 2 else "r")
+
+    best["freshness_ms"] = measure_freshness(
+        _join_feed, ex.flush_changes, 16)
     best.update(bench_changelog_decode())
     return best
 
@@ -845,6 +901,24 @@ def server_path_eps() -> dict:
             "fetch_p50": pct("fetch_latency_ms", 50),
             "fetch_p99": pct("fetch_latency_ms", 99),
         }
+
+        # end-to-end freshness of the SERVED path (ISSUE 13): the
+        # server's own freshness plane, observed during the phases
+        # above — append->visible p50/p99 plus the per-stage lag
+        # breakdown (ingest / engine / delivery; delivery samples come
+        # from the subscription fetches)
+        def fpct(metric: str, label: str, q: float):
+            v = stats.histogram_percentile(metric, label, q)
+            return None if v is None else round(v, 3)
+
+        out["freshness_ms"] = {
+            "p50": fpct("append_visible_latency_ms", "", 50),
+            "p99": fpct("append_visible_latency_ms", "", 99),
+        }
+        out["freshness_stages_ms"] = {
+            f"{stage}_{qn}": fpct("freshness_lag_ms", stage, qq)
+            for stage in ("ingest", "engine", "delivery")
+            for qn, qq in (("p50", 50), ("p99", 99))}
         pipe = getattr(task, "_pipe", None)
         if pipe is not None:
             out["server_pipeline_stages"] = {
@@ -975,6 +1049,29 @@ def main() -> None:
 
     close_ms, close_dispatch_ms = measure_close_latency(ex, pipe, src)
     p99_close = (float(np.percentile(close_ms, 99)) if close_ms else None)
+    # end-to-end freshness of the tumbling config (ISSUE 13): the
+    # close-latency samples ARE emit freshness — submit of the
+    # boundary-crossing batch -> closed rows decoded on host — split
+    # into dispatch (ingest + extract/reset dispatch) and drain (the
+    # D2H fetch + columnar decode)
+    if close_ms:
+        drain_ms = [t - d for t, d in zip(close_ms, close_dispatch_ms)]
+
+        def _pctf(xs, q):
+            return round(float(np.percentile(xs, q)), 3)
+
+        freshness = {
+            "samples": len(close_ms),
+            "p50": _pctf(close_ms, 50), "p99": _pctf(close_ms, 99),
+            "stages_ms": {
+                "dispatch_p50": _pctf(close_dispatch_ms, 50),
+                "dispatch_p99": _pctf(close_dispatch_ms, 99),
+                "drain_p50": _pctf(drain_ms, 50),
+                "drain_p99": _pctf(drain_ms, 99),
+            },
+        }
+    else:
+        freshness = {"samples": 0}
     kernel_eps = kernel_only_eps(ex, src)
     rtt_ms = measure_rtt()
 
@@ -999,6 +1096,7 @@ def main() -> None:
         "stddev_eps": round(float(np.std([r for r, _ in runs]))),
         "total_events": len(runs) * MEASURE_BATCHES * BATCH,
         "emitted_rows": emitted_rows,  # across all 3 runs
+        "freshness_ms": freshness,
         "p99_window_close_ms": (round(p99_close, 2)
                                 if p99_close is not None else None),
         "p50_window_close_ms": (round(float(np.percentile(close_ms, 50)),
@@ -1203,16 +1301,23 @@ def _smoke_server_columnar(batches: int = 50) -> int:
     from hstream_tpu.proto.rpc import HStreamApiStub
     from hstream_tpu.server.main import serve
 
-    server, ctx = serve("127.0.0.1", 0, "mem://")
+    # tracing ARMED at sample rate 1 (ISSUE 13 acceptance): every RPC
+    # and task stage records spans, and the steady state must still
+    # compile nothing — the span plane is host-only by construction
+    server, ctx = serve("127.0.0.1", 0, "mem://", trace_sample=1.0)
     ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
     stub = HStreamApiStub(ch)
     try:
         stub.CreateStream(pb.Stream(stream_name="smk"))
+        # request ids make every call's trace SAMPLED (trace id = rid),
+        # so the guarded region below runs with span recording live on
+        # the RPC path AND the query task's stage spans
         stub.ExecuteQuery(pb.CommandQuery(
             stmt_text="CREATE STREAM smkout AS SELECT device, "
                       "COUNT(*) AS c, SUM(temp) AS s FROM smk "
                       "GROUP BY device, TUMBLING (INTERVAL 1 SECOND) "
-                      "GRACE BY INTERVAL 0 SECOND;"))
+                      "GRACE BY INTERVAL 0 SECOND;"),
+            metadata=(("x-request-id", "smoke-create"),))
         deadline = time.time() + 30
         task = None
         while time.time() < deadline:
@@ -1255,7 +1360,8 @@ def _smoke_server_columnar(batches: int = 50) -> int:
             stub.AppendColumnarStream(iter(
                 [pb.AppendColumnarRequest(stream_name="smk",
                                           blocks=[f])
-                 for _l, f in reqs]))
+                 for _l, f in reqs]),
+                metadata=(("x-request-id", f"smoke-{lo}"),))
             drain_to(reqs[-1][0])
 
         for b in range(3):  # slow path first: one batch per poll

@@ -78,10 +78,38 @@ def cmd_stats(stub, args) -> list[dict]:
 def cmd_trace(stub, args) -> list[dict]:
     from hstream_tpu.common import records as rec
 
+    if getattr(args, "spans", False):
+        # Chrome trace-event JSON of the query's span ring (ISSUE 13):
+        # printed raw so it pipes straight into a .json file for
+        # chrome://tracing / Perfetto
+        import json
+
+        out = _admin(stub, "trace-spans", scope=args.id)
+        print(json.dumps(out[0] if out else {}))
+        return []
     summary = rec.struct_to_dict(
         stub.GetQueryTrace(pb.GetQueryRequest(id=args.id)))
     return [{"stage": stage, **vals}
             for stage, vals in sorted(summary.items())]
+
+
+def cmd_health(stub, args) -> list[dict]:
+    """Per-query health rollup (ISSUE 13): OK/DEGRADED/STALLED with
+    reasons, one row per query (or one query with --id)."""
+    if args.id:
+        rows = _admin(stub, "health", query=args.id)
+    else:
+        # the verb returns qid -> health dict; _admin renders that as
+        # one {"key": qid, **health} row per query, already sorted
+        rows = _admin(stub, "health")
+    return [{"query": h.get("query"), "verdict": h.get("verdict"),
+             "reasons": ",".join(h.get("reasons") or []) or "-",
+             "status": h.get("status"),
+             "wm_lag_ms": h.get("watermark_lag_ms"),
+             "backlog": h.get("backlog"),
+             "fallbacks": h.get("device_fallbacks"),
+             "late_drops": h.get("late_drops")}
+            for h in rows]
 
 
 def cmd_restart_query(stub, args) -> list[dict]:
@@ -292,6 +320,15 @@ def main(argv=None) -> int:
         sub.add_parser(name)
     p = sub.add_parser("trace")
     p.add_argument("id", help="running query id (e.g. view-<name>)")
+    p.add_argument("--spans", action="store_true",
+                   help="print the query's span ring as Chrome "
+                        "trace-event JSON (server needs "
+                        "--trace-sample > 0)")
+    p = sub.add_parser("health",
+                       help="per-query health rollup: OK/DEGRADED/"
+                            "STALLED with reasons")
+    p.add_argument("id", nargs="?", default=None,
+                   help="one query id (default: every query)")
     p = sub.add_parser("restart-query")
     p.add_argument("id")
     p = sub.add_parser("terminate-query")

@@ -101,7 +101,18 @@ class Client:
         return self.last_request_id
 
     def _metadata(self) -> tuple:
-        return ((REQUEST_ID_KEY, self._new_request_id()),)
+        # trace context (ISSUE 13): the request id doubles as the
+        # trace id, and the client hop names itself as the parent span
+        # — the server decides sampling from the id, so an unarmed
+        # server pays one metadata compare
+        from hstream_tpu.common.tracing import (
+            PARENT_SPAN_KEY,
+            TRACE_ID_KEY,
+        )
+
+        rid = self._new_request_id()
+        return ((REQUEST_ID_KEY, rid), (TRACE_ID_KEY, rid),
+                (PARENT_SPAN_KEY, f"cli-{rid}"))
 
     def _follow_leader_hint(self, hint: str) -> None:
         """The server lost store leadership: reconnect to the hinted

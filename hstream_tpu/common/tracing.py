@@ -12,14 +12,29 @@ ring slot.
 for the admin surface (admin CLI `trace` command, HTTP /queries/<id>).
 `jax_profiler(path)` wraps jax.profiler.trace for deep device profiles
 (TensorBoard format) when an operator asks for one.
+
+ISSUE 13 grows the request-id correlation into cross-component trace
+spans: `SpanCollector` keeps bounded per-scope rings of completed spans
+(trace id + span id + parent), exported as Chrome trace-event JSON via
+`GET /queries/<id>/trace` / `admin trace --spans`. The trace id IS the
+request id (already propagated client -> gateway -> handler), so one
+sampled request's journey — RPC handler, append-front stages, the
+query task's pipeline stages, subscription delivery — shares one id.
+Disarmed cost is ONE attribute read + one branch (`collector.active`,
+the FlowGovernor / FAULTS discipline); the sampling decision is a
+deterministic hash of the trace id so every component agrees without
+coordination.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import threading
 import time
-from collections import defaultdict, deque
+import uuid
+import zlib
+from collections import defaultdict, deque, OrderedDict
 
 
 class QueryTracer:
@@ -40,6 +55,25 @@ class QueryTracer:
         self._lock = threading.Lock()
         self._observer = observer
         self.request_id: str | None = None
+        # cross-component trace binding (ISSUE 13): when the request
+        # that created this query was SAMPLED, every completed stage
+        # timing also lands as a span in the collector's per-query
+        # ring, under the creating request's trace id. Unbound cost:
+        # one attribute read + one branch per record().
+        self._spans: "SpanCollector | None" = None
+        self._span_scope: str | None = None
+        self._trace_id: str | None = None
+        self._parent_span: str = ""
+
+    def bind_trace(self, collector: "SpanCollector", *, scope: str,
+                   trace_id: str, parent_id: str = "") -> None:
+        """Attach this tracer's stage timings to a sampled trace: spans
+        land in `collector` under `scope` (the query id), parented on
+        the creating request's handler span."""
+        self._span_scope = scope
+        self._trace_id = trace_id
+        self._parent_span = parent_id
+        self._spans = collector
 
     def record(self, stage: str, seconds: float) -> None:
         with self._lock:
@@ -51,6 +85,17 @@ class QueryTracer:
                 self._observer(stage, seconds)
             except Exception:  # noqa: BLE001 — observers are metrics
                 pass           # plumbing; never fail the traced stage
+        spans = self._spans
+        if spans is not None:
+            try:
+                dur_ms = seconds * 1e3
+                spans.record_span(
+                    self._span_scope, stage,
+                    trace_id=self._trace_id, span_id=new_span_id(),
+                    parent_id=self._parent_span,
+                    t0_ms=time.time() * 1e3 - dur_ms, dur_ms=dur_ms)
+            except Exception:  # noqa: BLE001 — span plumbing must
+                pass           # never fail the traced stage
 
     def summary(self) -> dict[str, dict[str, float]]:
         """stage -> {count, total_ms, mean_ms, p50_ms, p95_ms} over the
@@ -88,6 +133,181 @@ def trace_span(tracer: QueryTracer | None, stage: str):
         yield
     finally:
         tracer.record(stage, time.perf_counter() - t0)
+
+
+# ---- cross-component trace spans (ISSUE 13) --------------------------------
+
+# gRPC metadata / HTTP header keys the trace context travels under.
+# The trace id itself rides the existing x-request-id; only the parent
+# span id needs a new key.
+TRACE_ID_KEY = "x-trace-id"
+PARENT_SPAN_KEY = "x-parent-span"
+
+# THE declared stage vocabulary: every span name / trace_span stage /
+# append-stage literal must come from this set. The analyzer registry
+# pass cross-checks call sites against it (a renamed stage would
+# otherwise silently orphan its stage_latency_ms series and its spans).
+TRACE_STAGES = frozenset({
+    # query-task pipeline stages (QueryTracer rings + stage_latency_ms)
+    "decode", "key_encode", "step", "emit", "snapshot", "close",
+    # framed-append stages (handlers.APPEND_STAGES)
+    "append_decode", "append_admit", "append_handoff", "append_store",
+    # RPC entry span + the freshness lag taxonomy (freshness_lag_ms
+    # stage labels double as span names where a span exists)
+    "rpc", "ingest", "engine", "delivery",
+})
+
+# kernel dispatch families (per-family dispatch histograms + recompile
+# attribution) — also cross-checked by the analyzer registry pass
+KERNEL_FAMILIES = frozenset({"step", "close", "probe", "session"})
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+# the active span (trace_id, span_id) of the current request, bound by
+# the handler wrapper so nested instrumentation (append stages,
+# subscription delivery) can parent its spans without plumbing
+_span_ctx: "contextvars.ContextVar[tuple[str, str] | None]" = \
+    contextvars.ContextVar("hstream_span", default=None)
+
+
+def current_span() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the active sampled request, or None."""
+    return _span_ctx.get()
+
+
+@contextlib.contextmanager
+def span_scope(trace_id: str, span_id: str):
+    token = _span_ctx.set((trace_id, span_id))
+    try:
+        yield
+    finally:
+        _span_ctx.reset(token)
+
+
+class SpanCollector:
+    """Bounded per-scope rings of completed spans + the sampling knob.
+
+    A scope is the unit of export: a query id (`GET
+    /queries/<id>/trace`), a stream name (append-path spans), or a
+    subscription id (delivery spans). Rings are bounded per scope AND
+    the scope set itself is LRU-bounded, so a client looping over
+    random stream names cannot grow the collector without bound.
+
+    `active` is a plain attribute (False at sample rate 0) — the
+    disarmed hot-path cost is one attribute read + one branch, the
+    FlowGovernor / FAULTS discipline; `bench.py --smoke` gates that
+    arming the collector compiles nothing."""
+
+    def __init__(self, sample_rate: float = 0.0, *,
+                 ring_capacity: int = 512, max_scopes: int = 256):
+        self.sample_rate = max(0.0, min(float(sample_rate), 1.0))
+        self.active = self.sample_rate > 0.0
+        self._cap = int(ring_capacity)
+        self._max_scopes = int(max_scopes)
+        self._rings: "OrderedDict[str, deque]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def sampled(self, trace_id: str) -> bool:
+        """Deterministic per-trace sampling decision: every component
+        hashing the same trace id reaches the same verdict, so a trace
+        is recorded whole or not at all."""
+        if not self.active or not trace_id:
+            return False
+        if self.sample_rate >= 1.0:
+            return True
+        return (zlib.crc32(trace_id.encode()) % 10_000
+                < self.sample_rate * 10_000)
+
+    def record_span(self, scope: str, stage: str, *, trace_id: str,
+                    span_id: str, parent_id: str = "",
+                    t0_ms: float, dur_ms: float, **attrs) -> None:
+        """Append one completed span to the scope's ring. `t0_ms` is
+        wall epoch milliseconds; attrs must be JSON-serializable."""
+        span = {"stage": stage, "trace_id": trace_id,
+                "span_id": span_id, "parent_id": parent_id,
+                "t0_ms": round(float(t0_ms), 3),
+                "dur_ms": round(float(dur_ms), 3)}
+        if attrs:
+            span["attrs"] = attrs
+        with self._lock:
+            ring = self._rings.get(scope)
+            if ring is None:
+                while len(self._rings) >= self._max_scopes:
+                    self._rings.popitem(last=False)  # LRU scope bound
+                ring = deque(maxlen=self._cap)
+                self._rings[scope] = ring
+            else:
+                self._rings.move_to_end(scope)
+            ring.append(span)
+
+    def spans(self, scope: str) -> list[dict]:
+        with self._lock:
+            ring = self._rings.get(scope)
+            return list(ring) if ring is not None else []
+
+    def scopes(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def export_chrome(self, scope: str) -> dict:
+        """The scope's ring as Chrome trace-event JSON (load in
+        chrome://tracing or Perfetto): complete ("ph": "X") events,
+        microsecond timestamps, trace/span ids in args."""
+        events = []
+        for s in self.spans(scope):
+            events.append({
+                "name": s["stage"],
+                "cat": "hstream",
+                "ph": "X",
+                "ts": round(s["t0_ms"] * 1000.0, 1),   # us
+                "dur": max(round(s["dur_ms"] * 1000.0, 1), 1),
+                "pid": 1,
+                "tid": scope,
+                "args": {"trace_id": s["trace_id"],
+                         "span_id": s["span_id"],
+                         "parent_id": s["parent_id"],
+                         **s.get("attrs", {})},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---- kernel dispatch families (ISSUE 13 tentpole c) ------------------------
+#
+# One thread-local scope names the kernel family currently being
+# dispatched on this thread. jit compiles synchronously inside the
+# first call, so the process-wide compile listener reads the scope to
+# attribute a recompile to the factory family that triggered it —
+# RetraceGuard's listener otherwise collapses everything into one
+# undifferentiated count.
+
+_family_tls = threading.local()
+
+
+def current_kernel_family() -> str | None:
+    return getattr(_family_tls, "name", None)
+
+
+@contextlib.contextmanager
+def kernel_family(family: str, observer=None):
+    """Scope a kernel dispatch under a family name. When `observer`
+    (a callable (family, seconds)) is set, the dispatch's host time
+    lands there — the per-family dispatch-time histograms ride this.
+    Cost with no observer: two thread-local attribute writes."""
+    prev = getattr(_family_tls, "name", None)
+    _family_tls.name = family
+    t0 = time.perf_counter() if observer is not None else 0.0
+    try:
+        yield
+    finally:
+        _family_tls.name = prev
+        if observer is not None:
+            try:
+                observer(family, time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — observers are metrics
+                pass           # plumbing; never fail a dispatch
 
 
 @contextlib.contextmanager
@@ -144,6 +364,17 @@ def _ensure_compile_listener() -> None:
                 sinks = list(_stats_sinks)
             for g in guards:
                 g._bump()
+            # stream attribution (ISSUE 13 satellite): a compile seen
+            # while a NAMED guard is active counts against that guard's
+            # stream (the query/bench scope being driven), not the
+            # sink's default "_process" pseudo-stream — previously every
+            # recompile collapsed into _process and per-query recompile
+            # evidence was unrecoverable
+            names = sorted({g.name for g in guards if g.name})
+            # factory attribution: jit compiles synchronously inside
+            # the triggering call, so the dispatching thread's
+            # kernel_family scope names the factory family
+            family = current_kernel_family()
             dead = []
             for ref, stream in sinks:
                 stats = ref()
@@ -151,7 +382,12 @@ def _ensure_compile_listener() -> None:
                     dead.append((ref, stream))
                     continue
                 try:
-                    stats.stream_stat_add("kernel_recompiles", stream)
+                    for target in (names or [stream]):
+                        stats.stream_stat_add("kernel_recompiles",
+                                              target)
+                    if family:
+                        stats.stream_stat_add("factory_recompiles",
+                                              family)
                 except Exception:  # noqa: BLE001 — monitoring must
                     pass           # never break a compile
             if dead:
@@ -190,10 +426,16 @@ class RetraceGuard:
     `count` is exact: one per backend compile anywhere in the process
     while the guard is active (guards are process-global, like the
     compiles they observe — do not run two guarded regions
-    concurrently and expect per-region attribution)."""
+    concurrently and expect per-region attribution).
 
-    def __init__(self):
+    `name` (optional) attributes compiles observed while this guard is
+    active to that stream in every installed stats sink — the query id
+    or bench scope being driven — instead of the sink's default
+    `_process` pseudo-stream (ISSUE 13)."""
+
+    def __init__(self, name: str | None = None):
         self.count = 0
+        self.name = name
         self._lock = threading.Lock()
 
     def _bump(self) -> None:

@@ -40,6 +40,7 @@ from hstream_tpu.common.columnar import ColumnarEmit, extend_rows
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
+from hstream_tpu.common.tracing import kernel_family
 from hstream_tpu.engine import lattice, transport
 from hstream_tpu.engine.expr import (
     BinOp,
@@ -258,6 +259,14 @@ class QueryExecutor:
         self.stage_stats: dict[str, float] = {"upload_wait_s": 0.0,
                                               "drain_s": 0.0}
         self._stats_lock = threading.Lock()
+        # observability plane (ISSUE 13), all host-mirror values the
+        # owning task mirrors into /metrics: per-family dispatch-time
+        # observer (None = one branch per dispatch), late-record drops
+        # (the host twin of the device's watermark mask), and H2D/D2H
+        # byte totals on the staging and stacked-drain paths
+        self.dispatch_observer = None   # callable (family, seconds)
+        self.late_drops = 0
+        self.transfer_stats = {"h2d_bytes": 0, "d2h_bytes": 0}
 
     def _extract_filter(self) -> Expr | None:
         # Walk the child chain down to the source, ANDing every FilterNode
@@ -314,7 +323,8 @@ class QueryExecutor:
 
         def counted(*args):
             self.close_stats["close_dispatches"] += 1
-            return fn(*args)
+            with kernel_family("close", self.dispatch_observer):
+                return fn(*args)
 
         return counted
 
@@ -332,8 +342,10 @@ class QueryExecutor:
         step = lattice.compiled_encoded_step(
             self.spec, self.schema, self._filter_expr, combo, cap,
             donate_words=True)
-        self.state = step(self.state, wm_rel, np.int32(n), bases,
-                          self._device_stage(words))
+        staged_words = self._device_stage(words)
+        with kernel_family("step", self.dispatch_observer):
+            self.state = step(self.state, wm_rel, np.int32(n), bases,
+                              staged_words)
 
     def _encode_locked(self, cap, n, key_ids, ts_rel, cols, valid,
                        null_streams):
@@ -574,6 +586,7 @@ class QueryExecutor:
         # SQL NULL handling: a NULL operand makes the WHERE predicate
         # not-true (row excluded) and excludes the row from that aggregate.
         valid, null_streams = self._null_valid_streams(n, batch.nulls)
+        self._note_late(np.asarray(ts_ms, dtype=np.int64))
         self._run_step(cap, n, key_ids, ts_rel, batch.cols, valid,
                        null_streams, wm_rel)
 
@@ -592,6 +605,23 @@ class QueryExecutor:
         # the way to the caller
         out = extend_rows(out, self.close_due_windows())
         return out if out is not None else []
+
+    def _note_late(self, ts_arr: np.ndarray) -> None:
+        """Host mirror of the device's late mask (ISSUE 13): a record
+        whose NEWEST window is already past close at the pre-batch
+        watermark aggregates nowhere — count it so /metrics carries a
+        per-query late-drop series. Steady in-order streams pay one
+        integer compare (the quick gate); only batches actually
+        carrying late rows pay the vector count."""
+        w = self.window
+        if w is None or self.watermark_abs < 0 or len(ts_arr) == 0:
+            return
+        cutoff = self.watermark_abs - w.size_ms - w.grace_ms
+        lo = int(ts_arr.min())
+        if lo - lo % w.advance_ms > cutoff:
+            return
+        self.late_drops += int(np.count_nonzero(
+            ts_arr - ts_arr % w.advance_ms <= cutoff))
 
     def _track_windows(self, ts_abs: np.ndarray,
                        starts: set[int] | None = None) -> None:
@@ -696,6 +726,7 @@ class QueryExecutor:
         valid, null_streams = self._null_valid_streams(n, nulls)
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
+        self._note_late(ts_list)
         self._run_step(cap, n, key_ids, ts_rel64, cols, valid,
                        null_streams, wm_rel)
 
@@ -719,6 +750,9 @@ class QueryExecutor:
         so upload N+1 rides the link while batch N computes. Buffers
         already consumed (donated) by a step are skipped — donation IS
         the recycling of the staging slot."""
+        nbytes = getattr(words, "nbytes", None)
+        if nbytes is not None:
+            self.transfer_stats["h2d_bytes"] += int(nbytes)
         dev = jax.device_put(words)
         wait = None
         with self._upload_lock:
@@ -841,11 +875,13 @@ class QueryExecutor:
         # analyze: ok overflow-narrowing — caller-guarded narrow
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
+        self._note_late(ts_list)
         step = lattice.compiled_encoded_step(
             self.spec, self.schema, self._filter_expr, staged.combo,
             staged.cap, donate_words=True)
-        self.state = step(self.state, wm_rel, np.int32(staged.n),
-                          staged.bases, staged.words)
+        with kernel_family("step", self.dispatch_observer):
+            self.state = step(self.state, wm_rel, np.int32(staged.n),
+                              staged.bases, staged.words)
 
         out = None
         if self.window is not None:
@@ -915,7 +951,9 @@ class QueryExecutor:
     def _drain_changes(self) -> "ColumnarEmit | list[dict[str, Any]]":
         self.state, packed = self._extract_touched(self.state)
         if not self.defer_change_decode:
-            return self._decode_changes(np.asarray(packed), self.epoch)
+            host = np.asarray(packed)
+            self.transfer_stats["d2h_bytes"] += host.nbytes
+            return self._decode_changes(host, self.epoch)
         # the epoch is captured WITH the extract: a rebase between
         # extract and the deferred decode must not shift window bounds
         self._pending_changes.append((self.epoch, packed))
@@ -989,7 +1027,9 @@ class QueryExecutor:
             return []
         if len(pending) == 1:
             epoch, buf = pending[0]
-            return self._decode_changes(np.asarray(buf), epoch)
+            host = np.asarray(buf)
+            self.transfer_stats["d2h_bytes"] += host.nbytes
+            return self._decode_changes(host, epoch)
         rows = None
         by_shape: dict[tuple, list] = {}
         for ep, buf in pending:
@@ -997,6 +1037,7 @@ class QueryExecutor:
         for group in by_shape.values():
             stacked = np.asarray(lattice.stack_pow2(
                 [b for _, b in group]))
+            self.transfer_stats["d2h_bytes"] += stacked.nbytes
             for (ep, _), buf in zip(group, stacked):
                 rows = extend_rows(rows, self._decode_changes(buf, ep))
         return rows if rows is not None else []
@@ -1106,6 +1147,7 @@ class QueryExecutor:
             self.close_stats["close_fetches"] += 1
             try:
                 packed_host = np.asarray(packed)
+                self.transfer_stats["d2h_bytes"] += packed_host.nbytes
             except Exception as e:  # noqa: BLE001 — the dispatch is
                 # async: a device-side execution failure surfaces at
                 # this D2H sync, AFTER self.state was reassigned to the
@@ -1168,8 +1210,9 @@ class QueryExecutor:
         if len(self._pending_closes) == 1:
             starts, packed_dev = self._pending_closes[0]
             self.close_stats["close_fetches"] += 1
-            out = self._decode_extract_batch(np.asarray(packed_dev),
-                                             starts)
+            packed_host = np.asarray(packed_dev)
+            self.transfer_stats["d2h_bytes"] += packed_host.nbytes
+            out = self._decode_extract_batch(packed_host, starts)
             self._pending_closes.clear()  # only after decode succeeded
             return out if out is not None else []
         # Group by buffer shape: grow_keys() between two deferred closes
@@ -1183,6 +1226,7 @@ class QueryExecutor:
             self.close_stats["close_fetches"] += 1
             stacked = np.asarray(lattice.stack_pow2(
                 [p for _, p in group]))
+            self.transfer_stats["d2h_bytes"] += stacked.nbytes
             for (starts, _), packed in zip(group, stacked):
                 out = extend_rows(
                     out, self._decode_extract_batch(packed, starts))

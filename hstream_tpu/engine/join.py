@@ -40,6 +40,7 @@ import numpy as np
 
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.common.logger import get_logger
+from hstream_tpu.common.tracing import kernel_family
 from hstream_tpu.engine.expr import BinOp, Col, Expr, eval_host
 from hstream_tpu.engine.plan import AggregateNode
 from hstream_tpu.engine.statestore import LastValueStore
@@ -162,6 +163,9 @@ class _JoinBase:
         self.emit_changes = bool(getattr(plan, "emit_changes", False))
         self.supports_deferred_changes = True
         self._inner_tuning: dict[str, object] = {}
+        # observability plane (ISSUE 13): per-family dispatch observer
+        # for the probe kernel (the inner aggregate carries its own)
+        self.dispatch_observer = None   # callable (family, seconds)
 
     def _side_of(self, stream: str | None) -> str:
         if stream is None:
@@ -1491,9 +1495,10 @@ class JoinExecutor(_JoinBase):
         kern = lattice.join_probe_insert(
             dev["cap"], bcap, dev["match_cap"], len(lay),
             len(dev["lay"][other_side]))
-        dev["stores"][side], packed = kern(
-            dev["stores"][side], other, buf, np.int32(n),
-            np.int32(self.within), cutoff)
+        with kernel_family("probe", self.dispatch_observer):
+            dev["stores"][side], packed = kern(
+                dev["stores"][side], other, buf, np.int32(n),
+                np.int32(self.within), cutoff)
         self._note_insert(side, n)
         # the pending entry keeps (batch, other-store ref) alive so a
         # truncated match buffer could re-probe wider (unreachable
@@ -1572,10 +1577,11 @@ class JoinExecutor(_JoinBase):
             len(dev["lay"][side]), len(dev["lay"][other_side]),
             inner.spec, inner.schema, inner._filter_expr, feed,
             nulls_plan, filter_nulls)
-        dev["stores"][side], inner.state, _total = kern(
-            dev["stores"][side], dev["stores"][other_side], buf,
-            np.int32(n), np.int32(self.within), cutoff, inner.state,
-            wm_rel, ts_off)
+        with kernel_family("probe", self.dispatch_observer):
+            dev["stores"][side], inner.state, _total = kern(
+                dev["stores"][side], dev["stores"][other_side], buf,
+                np.int32(n), np.int32(self.within), cutoff, inner.state,
+                wm_rel, ts_off)
         self._note_insert(side, n)
         self.join_stats["fused_batches"] += 1
         # inner host bookkeeping over the conservative ts range (the

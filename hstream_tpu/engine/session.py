@@ -44,6 +44,7 @@ from hstream_tpu.common.columnar import ColumnarEmit, extend_rows
 from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
+from hstream_tpu.common.tracing import kernel_family
 from hstream_tpu.engine.executor import QueryExecutor
 from hstream_tpu.engine.expr import (
     columns_of,
@@ -328,6 +329,13 @@ class SessionExecutor:
             "close_dispatches": 0, "close_fetches": 0,
             "peek_dispatches": 0, "remap_dispatches": 0, "grows": 0,
         }
+        # observability plane (ISSUE 13): per-family dispatch observer,
+        # late-record drop count (both engines decide lateness on the
+        # host mirror), and H2D/D2H byte totals — all host values the
+        # owning task mirrors into /metrics
+        self.dispatch_observer = None   # callable (family, seconds)
+        self.late_drops = 0
+        self.transfer_stats = {"h2d_bytes": 0, "d2h_bytes": 0}
         self.dicts: dict[str, StringDictionary] = {
             name: StringDictionary() for name, t in schema.fields
             if t == ColumnType.STRING
@@ -685,6 +693,7 @@ class SessionExecutor:
         # is past grace AND cannot merge into any still-open session.
         if (not overl and self.watermark >= 0
                 and ts + gap + grace <= self.watermark):
+            self.late_drops += 1
             return False
         if overl:
             merged = overl[0]
@@ -1502,6 +1511,7 @@ class SessionExecutor:
                 and int(ts.min()) + gap + grace <= self.watermark:
             keep = self._late_keep_mask(codes, ts)
             if not keep.all():
+                self.late_drops += int(n - keep.sum())
                 idx = np.nonzero(keep)[0]
                 codes = codes[idx]
                 ts = ts[idx]
@@ -1620,10 +1630,14 @@ class SessionExecutor:
         packed = lattice.pack_batch_host(
             bcap, n, codes.astype(np.int32), ts_rel, None, cols,
             null_masks, dev["layout"])
+        self.transfer_stats["h2d_bytes"] += int(
+            getattr(packed, "nbytes", 0))
         step = lattice.session_step_kernel(
             dev["spec"], self.schema, dev["layout"], dev["cap"], bcap)
-        return step(dev["arena"], packed, np.int32(self.window.gap_ms),
-                    close_cut, np.int32(delta))
+        with kernel_family("session", self.dispatch_observer):
+            return step(dev["arena"], packed,
+                        np.int32(self.window.gap_ms), close_cut,
+                        np.int32(delta))
 
     def _dispatch_segment_merge(self, feed, order, starts, ends,
                                 seg_of_row_sorted, seg_code, seg_t0,
@@ -1640,10 +1654,14 @@ class SessionExecutor:
                                    seg_of_row_sorted, seg_code,
                                    seg_t0 - self.epoch,
                                    seg_t1 - self.epoch)
+        self.transfer_stats["h2d_bytes"] += sum(
+            int(getattr(v, "nbytes", 0)) for v in seg.values())
         kern = lattice.session_merge_kernel(dev["spec"], dev["cap"],
                                             len(seg["code"]))
-        return kern(dev["arena"], seg, np.int32(self.window.gap_ms),
-                    close_cut, np.int32(delta))
+        with kernel_family("session", self.dispatch_observer):
+            return kern(dev["arena"], seg,
+                        np.int32(self.window.gap_ms), close_cut,
+                        np.int32(delta))
 
     def _segment_planes(self, vv, order, starts, ends, seg_of_row,
                         seg_code, seg_t0_rel, seg_t1_rel
@@ -1907,7 +1925,9 @@ class SessionExecutor:
                                          None))
             return []
         self.session_stats["close_fetches"] += 1
-        return self._decode_close(np.asarray(packed_dev), codes, t0, t1)
+        packed_host = np.asarray(packed_dev)
+        self.transfer_stats["d2h_bytes"] += packed_host.nbytes
+        return self._decode_close(packed_host, codes, t0, t1)
 
     def _dispatch_extract(self, idx: np.ndarray):
         """One pow2-padded extract dispatch over the named arena slots;
@@ -1920,7 +1940,8 @@ class SessionExecutor:
             FAULTS.point("device.session.dispatch")
         kern = lattice.session_extract_kernel(dev["spec"], dev["cap"],
                                               len(slots))
-        return kern(dev["arena"], slots)
+        with kernel_family("close", self.dispatch_observer):
+            return kern(dev["arena"], slots)
 
     # contract: dispatches<=0 fetches<=1
     def drain_closed(self) -> list[dict[str, Any]]:
@@ -1939,8 +1960,9 @@ class SessionExecutor:
         if len(self._pending_closes) == 1:
             codes, t0, t1, packed_dev, keys = self._pending_closes[0]
             self.session_stats["close_fetches"] += 1
-            out = self._decode_close(np.asarray(packed_dev), codes, t0,
-                                     t1, keys)
+            packed_host = np.asarray(packed_dev)
+            self.transfer_stats["d2h_bytes"] += packed_host.nbytes
+            out = self._decode_close(packed_host, codes, t0, t1, keys)
             self._pending_closes.clear()  # only after decode succeeded
             return out if out is not None else []
         by_shape: dict[tuple, list[tuple]] = {}
@@ -1950,6 +1972,7 @@ class SessionExecutor:
             self.session_stats["close_fetches"] += 1
             stacked = np.asarray(lattice.stack_pow2(
                 [p for _c, _a, _b, p, _k in group]))
+            self.transfer_stats["d2h_bytes"] += stacked.nbytes
             for (codes, t0, t1, _, keys), packed in zip(group, stacked):
                 out = extend_rows(
                     out, self._decode_close(packed, codes, t0, t1, keys))

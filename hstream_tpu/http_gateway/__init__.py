@@ -26,6 +26,8 @@ Routes (JSON in/out unless noted):
                                         block (octet-stream, ISSUE 12)
   GET    /queries | POST /queries {"sql": ...} | GET|DELETE /queries/<id>
   POST   /queries/<id>/restart
+  GET    /queries/<id>/health         OK/DEGRADED/STALLED rollup
+  GET    /queries/<id>/trace          span ring, Chrome trace JSON
   GET    /views | GET /views/<name> (pull query) | DELETE /views/<name>
   GET    /connectors | POST /connectors {"config": sql} | DELETE .../<id>
   GET    /nodes
@@ -68,8 +70,9 @@ _STATUS = {
 
 
 class _CorrelatedStub:
-    """Stub proxy stamping the active request's correlation id into
-    every proxied gRPC call's metadata."""
+    """Stub proxy stamping the active request's correlation id — and
+    its trace context (ISSUE 13: trace id = request id, parent span =
+    this gateway hop) — into every proxied gRPC call's metadata."""
 
     def __init__(self, stub: HStreamApiStub):
         self._stub = stub
@@ -80,7 +83,12 @@ class _CorrelatedStub:
         def call(request, **kwargs):
             rid = current_request_id()
             if rid and "metadata" not in kwargs:
-                kwargs["metadata"] = ((REQUEST_ID_KEY, rid),)
+                from hstream_tpu.common import tracing
+
+                kwargs["metadata"] = (
+                    (REQUEST_ID_KEY, rid),
+                    (tracing.TRACE_ID_KEY, rid),
+                    (tracing.PARENT_SPAN_KEY, f"gw-{rid}"))
             return fn(request, **kwargs)
 
         return call
@@ -243,6 +251,15 @@ class Gateway:
             if m and method == "POST":
                 stub.RestartQuery(pb.RestartQueryRequest(id=m.group(1)))
                 return 200, {"restarted": m.group(1)}
+            m = re.fullmatch(r"/queries/([^/]+)/health", path)
+            if m and method == "GET":
+                # per-query health rollup (ISSUE 13): OK/DEGRADED/
+                # STALLED + reasons, 404 for unknown queries
+                return 200, self._admin("health", query=m.group(1))
+            m = re.fullmatch(r"/queries/([^/]+)/trace", path)
+            if m and method == "GET":
+                # the query's span ring as Chrome trace-event JSON
+                return 200, self._admin("trace-spans", scope=m.group(1))
 
             if path == "/views" and method == "GET":
                 out = stub.ListViews(pb.ListViewsRequest())
@@ -479,6 +496,12 @@ SWAGGER = {
         "/queries/{id}": {"get": {"summary": "get query"},
                           "delete": {"summary": "delete query"}},
         "/queries/{id}/restart": {"post": {"summary": "restart query"}},
+        "/queries/{id}/health": {
+            "get": {"summary": "health rollup: OK/DEGRADED/STALLED "
+                               "with reasons + freshness evidence"}},
+        "/queries/{id}/trace": {
+            "get": {"summary": "span ring as Chrome trace-event JSON "
+                               "(needs --trace-sample > 0)"}},
         "/views": {"get": {"summary": "list views"}},
         "/views/{name}": {"get": {"summary": "pull-query the view"},
                           "delete": {"summary": "drop view"}},

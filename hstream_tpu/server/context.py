@@ -41,7 +41,10 @@ class ServerContext:
                  encode_workers: int = DEFAULT_ENCODE_WORKERS,
                  credit_window: int | None = None,
                  slow_request_ms: float = 1000.0,
-                 append_lanes: int = DEFAULT_APPEND_LANES):
+                 append_lanes: int = DEFAULT_APPEND_LANES,
+                 trace_sample: float = 0.0,
+                 health_degraded_ms: float | None = None,
+                 health_stalled_ms: float | None = None):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
@@ -86,6 +89,28 @@ class ServerContext:
         self.stats.gauge_fn("event_journal_size", "",
                             lambda: len(self.events))
         self.slow_request_ms = float(slow_request_ms)
+        # cross-component trace spans (ISSUE 13): bounded per-scope
+        # rings + the --trace-sample knob; disarmed (rate 0) cost is
+        # one attribute read + one branch at every probe site
+        from hstream_tpu.common.tracing import SpanCollector
+
+        self.tracing = SpanCollector(sample_rate=trace_sample)
+        # per-query health plane (ISSUE 13): progress memory + verdict
+        # transitions behind GET /queries/<id>/health, admin health,
+        # and the query_health_level gauge
+        from hstream_tpu.server.health import (
+            DEGRADED_AFTER_MS,
+            STALLED_AFTER_MS,
+            HealthTracker,
+        )
+
+        self.health = HealthTracker()
+        self.health_degraded_ms = float(
+            DEGRADED_AFTER_MS if health_degraded_ms is None
+            else health_degraded_ms)
+        self.health_stalled_ms = float(
+            STALLED_AFTER_MS if health_stalled_ms is None
+            else health_stalled_ms)
         # a replicated store journals degraded acks / follower loss;
         # the leadership binding itself is the first journal entry, so
         # `admin events --kind leader_change` answers "who leads this

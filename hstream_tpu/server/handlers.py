@@ -37,6 +37,7 @@ from hstream_tpu.common.logger import (
     get_logger,
     request_context,
 )
+from hstream_tpu.common import tracing
 from hstream_tpu.proto import api_pb2 as pb
 from hstream_tpu.server.context import ServerContext
 from hstream_tpu.server import scheduler
@@ -121,6 +122,35 @@ def _request_id_from(context) -> str:
     return ""
 
 
+def _trace_from(context, rid: str) -> tuple[str, str]:
+    """(trace id, parent span id) of the incoming request: the
+    x-trace-id metadata when stamped, else the request id itself — the
+    correlation id IS the trace id (ISSUE 13), so a request traced
+    nowhere upstream still gets a coherent trace."""
+    trace_id, parent = rid, ""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == tracing.TRACE_ID_KEY:
+                trace_id = str(v)
+            elif k == tracing.PARENT_SPAN_KEY:
+                parent = str(v)
+    except Exception:  # noqa: BLE001 — metadata is best-effort
+        pass
+    return trace_id, parent
+
+
+def _trace_scope(request, result) -> str:
+    """The ring a handler span lands in: the query id it touched (or
+    created), else the target stream/subscription, else the shared
+    _rpc scope."""
+    for obj in (result, request):
+        for attr in ("id", "stream_name", "subscription_id"):
+            v = getattr(obj, attr, "")
+            if isinstance(v, str) and v:
+                return v
+    return "_rpc"
+
+
 def _producer_from(context) -> tuple[str, int] | None:
     """SQL INSERT idempotence stamp: Append carries the producer on the
     request proto; ExecuteQuery carries it as `x-producer-id` /
@@ -199,11 +229,27 @@ def unary(fn):
     def wrapped(self, request, context):
         rid = _request_id_from(context)
         t0 = time.perf_counter()
+        # trace context (ISSUE 13): one branch when tracing is
+        # disarmed; when the trace id samples in, the handler body runs
+        # under a span scope so nested probes (append stages, delivery)
+        # parent correctly, and the RPC span lands on completion
+        tr = self.ctx.tracing
+        span = None  # (trace_id, span_id, parent_id)
+        if tr.active:
+            trace_id, parent = _trace_from(context, rid)
+            if tr.sampled(trace_id):
+                span = (trace_id, tracing.new_span_id(), parent)
+        result = None
         with request_context(rid):
             try:
                 if FAULTS.active:  # chaos: fail/delay at handler entry
                     FAULTS.point("rpc.handler")
-                return fn(self, request, context)
+                if span is None:
+                    result = fn(self, request, context)
+                else:
+                    with tracing.span_scope(span[0], span[1]):
+                        result = fn(self, request, context)
+                return result
             except HStreamError as e:
                 _abort_hstream(context, e)
             except grpc.RpcError:
@@ -214,6 +260,18 @@ def unary(fn):
                               f"{type(e).__name__}: {e}")
             finally:
                 _finish_rpc(self, fn.__name__, request, rid, t0)
+                if span is not None:
+                    dur_ms = (time.perf_counter() - t0) * 1e3
+                    try:
+                        tr.record_span(
+                            _trace_scope(request, result), "rpc",
+                            trace_id=span[0], span_id=span[1],
+                            parent_id=span[2],
+                            t0_ms=time.time() * 1e3 - dur_ms,
+                            dur_ms=dur_ms, rpc=fn.__name__,
+                            ok=result is not None)
+                    except Exception:  # noqa: BLE001 — span plumbing
+                        pass           # must never fail the RPC
 
     return wrapped
 
@@ -354,6 +412,36 @@ class HStreamApiServicer:
         except Exception:  # noqa: BLE001 — metrics must not fail RPCs
             pass
 
+    def _trace_stage_span(self, scope: str, stage: str,
+                          dur_s: float) -> None:
+        """One child span under the active sampled request (no-op when
+        tracing is disarmed or the request wasn't sampled)."""
+        tr = self.ctx.tracing
+        if not tr.active:
+            return
+        sctx = tracing.current_span()
+        if sctx is None:
+            return
+        dur_ms = dur_s * 1e3
+        try:
+            tr.record_span(scope, stage, trace_id=sctx[0],
+                           span_id=tracing.new_span_id(),
+                           parent_id=sctx[1],
+                           t0_ms=time.time() * 1e3 - dur_ms,
+                           dur_ms=dur_ms)
+        except Exception:  # noqa: BLE001 — span plumbing must never
+            pass           # fail the RPC
+
+    def _bind_task_trace(self, task, scope: str) -> None:
+        """Attach a newly launched query task to the creating request's
+        sampled trace: its pipeline-stage timings then land as spans in
+        the query's ring, parented on the handler span."""
+        tr = self.ctx.tracing
+        sctx = tracing.current_span()
+        if tr.active and sctx is not None:
+            task.tracer.bind_trace(tr, scope=scope, trace_id=sctx[0],
+                                   parent_id=sctx[1])
+
     # contract: dispatches<=0 fetches<=0
     def _append_blocks(self, stream: str, blocks
                        ) -> tuple["object", int, int, int]:
@@ -394,6 +482,10 @@ class HStreamApiServicer:
         self._observe_append_stage("append_decode", t1 - t0)
         self._observe_append_stage("append_admit", t2 - t1)
         self._observe_append_stage("append_handoff", t3 - t2)
+        if ctx.tracing.active:
+            self._trace_stage_span(stream, "append_decode", t1 - t0)
+            self._trace_stage_span(stream, "append_admit", t2 - t1)
+            self._trace_stage_span(stream, "append_handoff", t3 - t2)
         return fut, len(wraps), rows, nbytes
 
     def _settle_appends(self, stream: str, entries: list
@@ -421,8 +513,10 @@ class HStreamApiServicer:
                 blocks += nblocks
                 rows += r
                 nbytes += nb
-        self._observe_append_stage("append_store",
-                                   time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._observe_append_stage("append_store", dt)
+        if self.ctx.tracing.active:
+            self._trace_stage_span(stream, "append_store", dt)
         return ids, blocks, rows, nbytes, err
 
     def _note_landed(self, stream: str, blocks: int, rows: int,
@@ -1130,6 +1224,28 @@ class HStreamApiServicer:
             from hstream_tpu.stats.prometheus import render_metrics
 
             out = {"text": render_metrics(ctx)}
+        elif cmd == "health":
+            # per-query health rollup (ISSUE 13): OK/DEGRADED/STALLED
+            # with reasons — GET /queries/<id>/health and `admin
+            # health` both land here
+            from hstream_tpu.server import health as _health
+
+            q = args.get("query") or None
+            if q:
+                out = _health.evaluate_query(ctx, str(q))
+            else:
+                out = _health.evaluate_all(ctx)  # qid -> health dict
+        elif cmd == "trace-spans":
+            # one scope's span ring as Chrome trace-event JSON
+            # (GET /queries/<id>/trace, `admin trace --spans`)
+            scope = str(args.get("scope") or "")
+            if not scope:
+                raise ServerError(
+                    "trace-spans needs scope=<query id | stream | "
+                    "subscription>")
+            out = ctx.tracing.export_chrome(scope)
+            out["scope"] = scope
+            out["sample_rate"] = ctx.tracing.sample_rate
         else:
             raise ServerError(f"unknown admin command {cmd!r}")
         return pb.AdminCommandResponse(result=_json.dumps(out))
@@ -1451,8 +1567,11 @@ class HStreamApiServicer:
         task = QueryTask(ctx, info, plan,
                          stream_sink(ctx, sink_stream, sink_type))
         # correlation: the creating request's id rides the tracer so
-        # `admin trace` ties a running query back to who launched it
+        # `admin trace` ties a running query back to who launched it;
+        # a SAMPLED creating request additionally binds the task's
+        # stage timings into its trace (ISSUE 13)
         task.tracer.request_id = current_request_id() or None
+        self._bind_task_trace(task, query_id)
         ctx.running_queries[query_id] = task
         task.start()
         return info
@@ -1509,6 +1628,7 @@ class HStreamApiServicer:
         task.sink_dump = mat.dump
         task.sink_load = mat.load
         mat.task = task
+        self._bind_task_trace(task, info.query_id)
         ctx.views.register(info.sink, mat)
         ctx.running_queries[info.query_id] = task
         task.start()

@@ -53,7 +53,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           credit_window: int | None = None,
           metrics_port: int | None = None,
           slow_request_ms: float = 1000.0,
-          faults: str | None = None
+          faults: str | None = None,
+          trace_sample: float = 0.0,
+          health_degraded_ms: float | None = None,
+          health_stalled_ms: float | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -85,7 +88,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         encode_workers=encode_workers,
                         credit_window=credit_window,
                         slow_request_ms=slow_request_ms,
-                        append_lanes=append_lanes)
+                        append_lanes=append_lanes,
+                        trace_sample=trace_sample,
+                        health_degraded_ms=health_degraded_ms,
+                        health_stalled_ms=health_stalled_ms)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
         # HSTREAM_FAULTS, which ServerContext already loaded)
@@ -203,6 +209,20 @@ def _parse_args(argv):
                          "'store.append=fail:3;snapshot.persist="
                          "torn:2:7' (also: HSTREAM_FAULTS env, admin "
                          "fault-set at runtime)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    help="cross-component span sampling rate in [0,1]: "
+                         "0 disarms tracing (one-branch cost), 1 "
+                         "records every request's spans into the "
+                         "per-query rings (GET /queries/<id>/trace, "
+                         "admin trace --spans); default 0")
+    ap.add_argument("--health-degraded-ms", type=float, default=None,
+                    help="health plane: backlog with no watermark "
+                         "advance for this long reads DEGRADED "
+                         "(default 5000)")
+    ap.add_argument("--health-stalled-ms", type=float, default=None,
+                    help="health plane: backlog with no watermark "
+                         "advance for this long reads STALLED and "
+                         "journals query_stalled (default 30000)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -218,7 +238,10 @@ def _parse_args(argv):
                 "credit_window": None,
                 "metrics_port": None,
                 "slow_request_ms": 1000.0,
-                "faults": None}
+                "faults": None,
+                "trace_sample": 0.0,
+                "health_degraded_ms": None,
+                "health_stalled_ms": None}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -261,7 +284,10 @@ def main(argv=None) -> None:
         credit_window=cfg["credit_window"],
         metrics_port=cfg["metrics_port"],
         slow_request_ms=cfg["slow_request_ms"],
-        faults=cfg["faults"])
+        faults=cfg["faults"],
+        trace_sample=cfg["trace_sample"],
+        health_degraded_ms=cfg["health_degraded_ms"],
+        health_stalled_ms=cfg["health_stalled_ms"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -241,8 +241,10 @@ class SubscriptionRuntime:
               ) -> list[tuple[RecId, bytes]]:
         r = self.reader()
         r.set_timeout(int(timeout_ms))
+        t0 = time.perf_counter()
         results = r.read(max(int(max_size), 1))
         out: list[tuple[RecId, bytes]] = []
+        newest = 0
         with self.lock:
             for item in results:
                 if isinstance(item, DataBatch):
@@ -256,10 +258,47 @@ class SubscriptionRuntime:
                     self.window.note_batch(item.lsn, len(payloads))
                     for i, payload in enumerate(payloads):
                         out.append((RecId(item.lsn, i), payload))
+                    if item.append_time_ms > newest:
+                        newest = item.append_time_ms
                 elif isinstance(item, GapRecord):
                     self.window.note_gap(item.lo_lsn, item.hi_lsn)
             self._maybe_commit()
+        if out:
+            self._note_delivery(newest, t0)
         return out
+
+    def _note_delivery(self, newest_append_ms: int, t0: float) -> None:
+        """Freshness + tracing at the delivery boundary (ISSUE 13):
+        append->delivery latency of the newest delivered record (the
+        delivery stage of the lag taxonomy), and a `delivery` span
+        when the fetching request is sampled. Host arithmetic only;
+        never fails a fetch."""
+        from hstream_tpu.common import tracing
+
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None and newest_append_ms > 0:
+            try:
+                lag = max(0.0, time.time() * 1e3 - newest_append_ms)
+                stats.observe("freshness_lag_ms", "delivery", lag)
+                stats.observe("append_visible_latency_ms", self.sub_id,
+                              lag)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # delivery
+        tr = getattr(self.ctx, "tracing", None)
+        if tr is not None and tr.active:
+            sctx = tracing.current_span()
+            if sctx is not None:
+                trace_id, parent = sctx
+                dur_ms = (time.perf_counter() - t0) * 1e3
+                try:
+                    tr.record_span(
+                        self.sub_id, "delivery", trace_id=trace_id,
+                        span_id=tracing.new_span_id(),
+                        parent_id=parent,
+                        t0_ms=time.time() * 1e3 - dur_ms,
+                        dur_ms=dur_ms)
+                except Exception:  # noqa: BLE001 — span plumbing must
+                    pass           # never fail delivery
 
     def ack(self, rec_ids: list[RecId],
             consumer: "Consumer | None" = None) -> None:

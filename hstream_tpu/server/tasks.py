@@ -105,7 +105,6 @@ class QueryTask(threading.Thread):
         self.ctx = ctx
         self.info = info
         self.plan = plan
-        self.sink = sink
         self.from_beginning = from_beginning
         # per-context override wins over the class default (main.serve)
         ctx_iv = getattr(ctx, "snapshot_interval_ms", None)
@@ -174,12 +173,133 @@ class QueryTask(threading.Thread):
         # that degraded to the host reference path on themselves;
         # deltas land in the device_path_fallbacks counter
         self._dev_fallback_seen = 0
+        # engine-counter mirrors (ISSUE 13): late drops + H2D/D2H
+        # bytes, delta-based like the fallback mirror
+        self._late_seen = 0
+        self._h2d_seen = 0
+        self._d2h_seen = 0
+        # event-time freshness plane (ISSUE 13): the publish-time
+        # watermark of ingested records (max record append/publish ms
+        # seen) and the wall clock when it was picked up — emission
+        # observes append->visible and per-stage lag from these, all
+        # host values (zero added dispatches/fetches)
+        self._publish_wm_ms = -1
+        self._pickup_wall_ms = 0.0
+        # every emission flows through the freshness-instrumented sink
+        self.sink = self._wrap_sink(sink)
 
     def _observe_stage(self, stage: str, seconds: float) -> None:
         stats = getattr(self.ctx, "stats", None)
         if stats is not None:
             try:
                 stats.observe("stage_latency_ms", stage, seconds * 1e3)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the ingest loop
+
+    def _observe_kernel(self, family: str, seconds: float) -> None:
+        """Engine dispatch observer (ISSUE 13): per-kernel-family host
+        dispatch time (step/close/probe/session) into /metrics."""
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.observe("kernel_dispatch_ms", family,
+                              seconds * 1e3)
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the ingest loop
+
+    # ---- event-time freshness plane (ISSUE 13) -----------------------------
+
+    def _wrap_sink(self, sink: SinkFn) -> SinkFn:
+        """Freshness-instrumented sink: every emission observes
+        append->visible latency (publish-time watermark -> now, the
+        end-to-end number for views and sink streams), the engine-stage
+        lag (wall since the publish watermark's pickup), and the close
+        cycle's event-time emit latency — host arithmetic only. The
+        original sink's durability barrier (`flush`) rides through."""
+        stats = getattr(self.ctx, "stats", None)
+        if stats is None:
+            return sink
+
+        def wrapped(rows):
+            sink(rows)
+            if rows is not None and len(rows):
+                self._note_emit_freshness(stats, rows)
+
+        flush = getattr(sink, "flush", None)
+        if flush is not None:
+            wrapped.flush = flush
+        return wrapped
+
+    def _note_emit_freshness(self, stats, rows) -> None:
+        now = time.time() * 1e3
+        qid = self.info.query_id
+        try:
+            if self._publish_wm_ms >= 0:
+                # append -> visible: the emitted answer now reflects
+                # (at least) everything published up to the watermark
+                stats.observe("append_visible_latency_ms", qid,
+                              max(0.0, now - self._publish_wm_ms))
+                # engine stage: pickup of the newest ingested records
+                # -> rows on the wire (pipeline depth + device work)
+                stats.observe("freshness_lag_ms", "engine",
+                              max(0.0, now - self._pickup_wall_ms))
+            wm = self._event_watermark()
+            win_end = _max_win_end(rows)
+            if wm is not None:
+                # emit latency: max event time the emitted rows can
+                # cover (their window end, capped at the watermark —
+                # the host mirror of "max event ts in the close
+                # cycle") -> wall at emission
+                ref = wm if win_end is None else min(win_end, wm)
+                stats.observe("emit_latency_ms", qid,
+                              max(0.0, now - ref))
+        except Exception:  # noqa: BLE001 — metrics must not kill
+            pass           # the emit path
+
+    def _event_watermark(self) -> int | None:
+        """The executor's event-time watermark (host attribute,
+        whichever engine): fixed windows track watermark_abs, sessions
+        and joins track watermark. The ONE place that fold lives —
+        the freshness gauges and the health plane both read it here."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return None
+        wm = getattr(ex, "watermark_abs", None)
+        if wm is None:
+            wm = getattr(ex, "watermark", None)
+        if wm is None or wm < 0:
+            return None
+        return int(wm)
+
+    def engine_total(self, attr: str) -> int:
+        """Sum a host counter over the executor AND a join's lazily
+        created inner aggregate (device_fallbacks, late_drops) — the
+        one fold the /metrics mirror and the health plane share."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return 0
+        total = int(getattr(ex, attr, 0))
+        inner = getattr(ex, "_inner", None)
+        if inner is not None:
+            total += int(getattr(inner, attr, 0))
+        return total
+
+    def _note_ingest_freshness(self, publish_ms: int) -> None:
+        """Called once per ingested chunk with the chunk's max record
+        publish/append time: advances the publish watermark (+ its
+        pickup wall clock) and observes the ingest-stage lag (time the
+        records sat in the store + read path)."""
+        now = time.time() * 1e3
+        if publish_ms > self._publish_wm_ms:
+            self._publish_wm_ms = publish_ms
+            self._pickup_wall_ms = now
+        stats = getattr(self.ctx, "stats", None)
+        if stats is not None:
+            try:
+                stats.observe("freshness_lag_ms", "ingest",
+                              max(0.0, now - publish_ms))
             except Exception:  # noqa: BLE001 — metrics must not kill
                 pass           # the ingest loop
 
@@ -408,29 +528,61 @@ class QueryTask(threading.Thread):
                  pipe.pending / max(self.pipeline_depth, 1), source=qid)
 
     def _note_device_fallbacks(self) -> None:
-        """Mirror engine-side device->host path degradations (join
-        activation / fused close falling back to the reference path)
-        into the device_path_fallbacks counter, labeled by the primary
-        source stream. Delta-based, called once per chunk/idle tick."""
+        """Mirror engine-side counters into /metrics, delta-based,
+        once per chunk/idle tick: device->host path degradations (join
+        activation / fused close falling back to the reference path),
+        late-record drops, and H2D/D2H transfer bytes — all plain host
+        counters the executors maintain on themselves."""
         with self.state_lock:  # executor is guarded (hstream-analyze)
             ex = self.executor
         if ex is None:
             return
-        cur = int(getattr(ex, "device_fallbacks", 0))
         inner = getattr(ex, "_inner", None)
-        if inner is not None:
-            cur += int(getattr(inner, "device_fallbacks", 0))
-        delta = cur - self._dev_fallback_seen
-        if delta <= 0:
-            return
-        self._dev_fallback_seen = cur
         stats = getattr(self.ctx, "stats", None)
-        if stats is not None:
+        if inner is not None \
+                and getattr(inner, "dispatch_observer", 1) is None:
+            # a join's downstream aggregate is created lazily — wire
+            # its dispatch observer the first time it appears
+            inner.dispatch_observer = self._observe_kernel
+
+        def transfer(key: str) -> int:
+            cur = int(getattr(ex, "transfer_stats", {}).get(key, 0))
+            if inner is not None:
+                cur += int(getattr(inner, "transfer_stats",
+                                   {}).get(key, 0))
+            return cur
+
+        cur = self.engine_total("device_fallbacks")
+        delta = cur - self._dev_fallback_seen
+        if delta > 0 and stats is not None:
+            self._dev_fallback_seen = cur
             try:
                 stats.stream_stat_add("device_path_fallbacks",
                                       self.plan.source, delta)
             except Exception:  # noqa: BLE001 — metrics must not kill
                 pass           # the ingest loop
+        if stats is None:
+            return
+        try:
+            late = self.engine_total("late_drops")
+            if late > self._late_seen:
+                stats.stream_stat_add("late_drops", self.info.query_id,
+                                      late - self._late_seen)
+                self._late_seen = late
+            h2d = transfer("h2d_bytes")
+            if h2d > self._h2d_seen:
+                stats.stream_stat_add("device_h2d_bytes",
+                                      self.plan.source,
+                                      h2d - self._h2d_seen)
+                self._h2d_seen = h2d
+            d2h = transfer("d2h_bytes")
+            if d2h > self._d2h_seen:
+                stats.stream_stat_add("device_d2h_bytes",
+                                      self.plan.source,
+                                      d2h - self._d2h_seen)
+                self._d2h_seen = d2h
+        except Exception:  # noqa: BLE001 — metrics must not kill
+            pass           # the ingest loop
 
     # ---- operator-state checkpointing --------------------------------------
 
@@ -538,7 +690,8 @@ class QueryTask(threading.Thread):
         if not pending:
             return
         with self.state_lock:
-            rows = ex.flush_changes()
+            with trace_span(self.tracer, "close"):
+                rows = ex.flush_changes()
             if rows:
                 with trace_span(self.tracer, "emit"):
                     self.sink(rows)
@@ -707,6 +860,11 @@ class QueryTask(threading.Thread):
         decode + engine step — per-append device dispatches would bound
         the JSON path at (records per append) / RTT on real links."""
         groups: list[tuple[int, list[bytes], list[int]]] = []
+        newest = max((r.append_time_ms for r in results
+                      if isinstance(r, DataBatch)), default=0)
+        if newest > 0:
+            # freshness plane: one ingest-lag observation per poll
+            self._note_ingest_freshness(newest)
         for r in results:
             if not isinstance(r, DataBatch):
                 continue
@@ -912,10 +1070,17 @@ class QueryTask(threading.Thread):
                            batch_capacity=cap, mesh=self._query_mesh())
         return self._tune_executor(ex)
 
-    @staticmethod
-    def _tune_executor(ex):
+    def _tune_executor(self, ex):
         """Per-task executor tuning, applied on BOTH the fresh and the
         snapshot-restore paths."""
+        # per-kernel-family dispatch histograms (ISSUE 13): the engine
+        # times its kernel dispatches into this task's observer (a
+        # join's lazily-created inner aggregate is wired by the
+        # per-chunk mirror when it appears)
+        for target in (ex, getattr(ex, "_inner", None)):
+            if target is not None and hasattr(target,
+                                              "dispatch_observer"):
+                target.dispatch_observer = self._observe_kernel
         if getattr(ex, "emit_changes", False) and \
                 getattr(ex, "supports_deferred_changes", False):
             # pipeline changelog fetches behind later batches' work and
@@ -1077,6 +1242,28 @@ class QueryTask(threading.Thread):
             if rows:
                 with trace_span(self.tracer, "emit"):
                     self.sink(rows)
+
+
+def _max_win_end(rows) -> float | None:
+    """Max winEnd of an emitted batch, without materializing a
+    ColumnarEmit's row view (read its columns directly); dict-row
+    lists scan at most 1024 rows (row-shaped emissions are small)."""
+    cols = getattr(rows, "cols", None)
+    if cols is not None:
+        we = cols.get("winEnd")
+        if we is None or len(we) == 0:
+            return None
+        try:
+            return float(np.max(we))
+        except (TypeError, ValueError):
+            return None
+    best = None
+    if isinstance(rows, list):
+        for row in rows[:1024]:
+            we = row.get("winEnd") if isinstance(row, dict) else None
+            if we is not None and (best is None or we > best):
+                best = we
+    return None if best is None else float(best)
 
 
 def _session_columns(cols: dict) -> dict:

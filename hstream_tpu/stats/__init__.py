@@ -57,6 +57,19 @@ PER_STREAM_COUNTERS = [
     "append_columnar_rows",    # rows ingested through the framed
                                # columnar append path (bounds-check +
                                # handoff, no per-record protobuf)
+    "late_drops",              # records dropped as late (past
+                               # end/gap + grace at the pre-batch
+                               # watermark), host-mirror count
+                               # (label: query id)
+    "device_h2d_bytes",        # host->device bytes on the staging
+                               # path (label: source stream)
+    "device_d2h_bytes",        # device->host bytes on the close/
+                               # changelog drain paths (label: source
+                               # stream)
+    "factory_recompiles",      # XLA executable builds attributed to
+                               # the kernel family whose dispatch
+                               # triggered them (label: step/close/
+                               # probe/session)
 ]
 
 PER_STREAM_TIME_SERIES = [
@@ -89,6 +102,13 @@ GAUGES = [
                               # store this server fronts
     "dedup_window_size",      # producer-dedup seqs remembered across
                               # all producers (bounded per producer)
+    "query_watermark_ms",     # per query: event-time watermark
+                              # (absolute ms) of the query's executor
+    "query_watermark_lag_ms", # per query: wall clock - watermark (the
+                              # Dataflow watermark-lag discipline: how
+                              # stale is the answer a reader sees)
+    "query_health_level",     # per query: 0 OK / 1 DEGRADED /
+                              # 2 STALLED (the health-plane verdict)
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);
@@ -98,12 +118,26 @@ LATENCY_BUCKETS_MS = (
     0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
     1000.0, 2500.0, 5000.0, 10000.0)
 
+# freshness latencies span a wider range than RPCs (a healthy pipeline
+# sits in the tens of ms; a stalled one drifts toward minutes), so the
+# freshness families get their own bucket ladder topping out at 60s
+FRESHNESS_BUCKETS_MS = (
+    1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0,
+    5000.0, 10000.0, 30000.0, 60000.0)
+
 HISTOGRAMS = [
     # name, bucket upper bounds (ms), label key
     ("append_latency_ms", LATENCY_BUCKETS_MS, "stream"),
     ("fetch_latency_ms", LATENCY_BUCKETS_MS, "subscription"),
     ("sql_execute_latency_ms", LATENCY_BUCKETS_MS, "stmt"),
     ("stage_latency_ms", LATENCY_BUCKETS_MS, "stage"),
+    # event-time freshness plane (ISSUE 13): how stale is the answer a
+    # reader sees, and where the milliseconds live
+    ("emit_latency_ms", FRESHNESS_BUCKETS_MS, "query"),
+    ("append_visible_latency_ms", FRESHNESS_BUCKETS_MS, "consumer"),
+    ("freshness_lag_ms", FRESHNESS_BUCKETS_MS, "stage"),
+    # per-kernel-family host dispatch time (step/close/probe/session)
+    ("kernel_dispatch_ms", LATENCY_BUCKETS_MS, "family"),
 ]
 
 _HIST_BUCKETS = {name: buckets for name, buckets, _label in HISTOGRAMS}

@@ -43,6 +43,11 @@ EVENT_KINDS = [
     "replica_promoted",  # a replica was raised to leadership
     "replica_ack_timeout",  # a follower-ack deadline expired; the
                             # append degraded honestly
+    "query_stalled",     # the health plane's verdict for a query
+                         # crossed into STALLED (backlog with no
+                         # watermark progress, crash loop, or a dead
+                         # unowned task) — the machine-readable signal
+                         # failover adoption and the placer gate on
 ]
 
 

@@ -35,8 +35,15 @@ PREFIX = "hstream"
 # not drop them (same rationale as "_"-prefixed pseudo-streams). A
 # restart/fallback series for a crash-looped (FAILED, detached) query
 # especially must survive the scrape — it is the evidence an operator
-# scrapes FOR.
-QUERY_LABEL_COUNTERS = frozenset({"query_restarts", "snapshot_fallbacks"})
+# scrapes FOR. kernel_recompiles joins the set with ISSUE 13's named
+# RetraceGuard attribution (a compile observed under a named guard
+# counts against that query/bench scope, not only `_process`).
+QUERY_LABEL_COUNTERS = frozenset({"query_restarts", "snapshot_fallbacks",
+                                  "late_drops", "kernel_recompiles"})
+
+# counters whose label is a closed vocabulary outside both the stream
+# and query namespaces (kernel families): never liveness-filtered
+FAMILY_LABEL_COUNTERS = frozenset({"factory_recompiles"})
 
 _HELP = {
     "append_payload_bytes": "bytes appended (payload only)",
@@ -69,6 +76,13 @@ _HELP = {
                       "dedup window (retries landed exactly once)",
     "append_columnar_rows": "rows ingested through the framed columnar "
                             "append path",
+    "late_drops": "records dropped as late (past the window close "
+                  "boundary at the pre-batch watermark)",
+    "device_h2d_bytes": "host-to-device bytes on the staging path",
+    "device_d2h_bytes": "device-to-host bytes on the close/changelog "
+                        "drain paths",
+    "factory_recompiles": "XLA executable builds attributed to the "
+                          "kernel family whose dispatch triggered them",
     "append_in_bytes": "append byte rate over the trailing window",
     "append_in_records": "append record rate over the trailing window",
     "record_bytes": "read byte rate over the trailing window",
@@ -88,10 +102,25 @@ _HELP = {
                      "server fronts",
     "dedup_window_size": "producer-dedup seqs remembered across all "
                          "producers",
+    "query_watermark_ms": "event-time watermark of the query's "
+                          "executor (absolute ms)",
+    "query_watermark_lag_ms": "wall clock minus the query's event-time "
+                              "watermark (answer staleness)",
+    "query_health_level": "health-plane verdict: 0 OK / 1 DEGRADED / "
+                          "2 STALLED",
     "append_latency_ms": "Append RPC latency",
     "fetch_latency_ms": "Fetch RPC latency",
     "sql_execute_latency_ms": "ExecuteQuery RPC latency",
     "stage_latency_ms": "per-stage query pipeline timings",
+    "emit_latency_ms": "close-cycle event time to emitted rows on the "
+                       "wire (per query)",
+    "append_visible_latency_ms": "record publish time to visibility "
+                                 "(view/sink emit, or subscription "
+                                 "delivery)",
+    "freshness_lag_ms": "end-to-end lag attributed per stage "
+                        "(ingest / engine / delivery)",
+    "kernel_dispatch_ms": "host dispatch time per kernel family "
+                          "(step / close / probe / session)",
 }
 
 
@@ -139,12 +168,14 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
         _header(lines, name, "counter", metric)
         for stream, v in sorted(stats.stream_stat_getall(metric).items()):
             # "_"-prefixed labels are process-scoped pseudo-streams
-            # (kernel_recompiles{stream="_process"}) and
-            # QUERY_LABEL_COUNTERS series are labeled by query id:
-            # neither is in the stream namespace, so the STREAM
-            # liveness filter must not drop them — query-labeled
-            # series are bounded by query existence instead
-            if not stream.startswith("_"):
+            # (kernel_recompiles{stream="_process"}),
+            # QUERY_LABEL_COUNTERS series are labeled by query id, and
+            # FAMILY_LABEL_COUNTERS by a closed kernel-family
+            # vocabulary: none is in the stream namespace, so the
+            # STREAM liveness filter must not drop them — query-
+            # labeled series are bounded by query existence instead
+            if not stream.startswith("_") \
+                    and metric not in FAMILY_LABEL_COUNTERS:
                 if metric in QUERY_LABEL_COUNTERS:
                     if (live_queries is not None
                             and stream not in live_queries):
@@ -194,7 +225,8 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
 
 
 def _gauge_label_key(metric: str) -> str:
-    if metric.startswith("pipeline_") or metric == "crash_loop_open":
+    if metric.startswith(("pipeline_", "query_")) \
+            or metric == "crash_loop_open":
         return "query"
     if metric in ("sub_backlog", "credit_inflight"):
         return "subscription"
@@ -312,6 +344,16 @@ def sample_gauges(ctx) -> None:
             stats.gauge_set("dedup_window_size", "", ls["dedup_window"])
         except Exception:  # noqa: BLE001 — a closing store must not
             pass           # fail the scrape
+    # event-time freshness + health verdicts (ISSUE 13): per-query
+    # watermark/lag gauges and the OK/DEGRADED/STALLED rollup — all
+    # host-mirror values, zero device work (server/health.py owns the
+    # thresholds and the query_stalled transition journal)
+    try:
+        from hstream_tpu.server.health import sample_health
+
+        sample_health(ctx)
+    except Exception:  # noqa: BLE001 — a half-built context (tests
+        pass           # construct bare ones) must not fail the scrape
     # durable store footprint (native store roots at a directory)
     root = getattr(ctx.store, "root", None) \
         or getattr(getattr(ctx.store, "local", None), "root", None)

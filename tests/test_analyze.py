@@ -698,6 +698,47 @@ def test_registry_dead_entry_flagged():
     assert any("append_total" in f.message for f in dead)
 
 
+def test_registry_stage_names_cross_checked():
+    """ISSUE 13 satellite: trace-span stage / kernel-family literals
+    are checked against tracing.TRACE_STAGES / KERNEL_FAMILIES — a
+    renamed stage silently orphans its histogram series and spans."""
+    code = '''
+    from hstream_tpu.common.tracing import kernel_family, trace_span
+
+    def f(tracer, tr, stats, obs):
+        with trace_span(tracer, "stepp"):        # typo'd stage
+            pass
+        with trace_span(tracer, "step"):         # declared: clean
+            pass
+        with kernel_family("probes", obs):       # typo'd family
+            pass
+        with kernel_family("probe", obs):        # declared: clean
+            pass
+        tr.record_span("q1", "emitt", trace_id="t", span_id="s",
+                       t0_ms=0.0, dur_ms=1.0)    # typo'd span stage
+        stats.observe("freshness_lag_ms", "ingress", 1.0)  # typo'd
+        stats.observe("freshness_lag_ms", "ingest", 1.0)   # declared
+        stats.observe("append_latency_ms", "anystream", 1.0)  # not a
+        # stage-labeled histogram: stream labels are free-form
+    '''
+    out = run_one(registry, [src("hstream_tpu/fixture.py", code)])
+    stage = [f for f in out if f.rule == "registry-stage"]
+    assert len(stage) == 4, stage
+    assert any("stepp" in f.message for f in stage)
+    assert any("probes" in f.message for f in stage)
+    assert any("emitt" in f.message for f in stage)
+    assert any("ingress" in f.message for f in stage)
+
+
+def test_registry_stage_clean_on_live_tree():
+    """Every stage/family literal in the production tree is declared."""
+    from tools.analyze import load_tree
+
+    out = [f for f in registry.run(load_tree(REPO), REPO)
+           if f.rule == "registry-stage"]
+    assert out == [], out
+
+
 # ---- dispatch (ISSUE 7) ----------------------------------------------------
 
 
@@ -1332,6 +1373,60 @@ def test_kernel_recompiles_counter_taps_compiles():
     install_recompile_counter(stats, stream="_test")
     jax.jit(lambda x: x - 7)(jnp.zeros(3))
     assert stats.stream_stat_get("kernel_recompiles", "_test") >= 1
+
+
+def test_named_guard_attributes_recompiles_to_stream():
+    """ISSUE 13 satellite: a compile observed while a NAMED guard is
+    active counts against that stream, not the sink's default
+    pseudo-stream — per-query recompile evidence used to collapse
+    into `_process` unrecoverably."""
+    import jax
+    import jax.numpy as jnp
+
+    from hstream_tpu.common.tracing import (
+        RetraceGuard,
+        install_recompile_counter,
+    )
+    from hstream_tpu.stats import StatsHolder
+
+    stats = StatsHolder()
+    install_recompile_counter(stats, stream="_namedtest")
+    with RetraceGuard(name="q-attr-1") as g:
+        jax.jit(lambda x: x * 3 + 11)(jnp.zeros(5))
+    assert g.count >= 1
+    named = stats.stream_stat_get("kernel_recompiles", "q-attr-1")
+    assert named >= 1
+    # the default sink stream saw NONE of the named-guard compiles
+    assert stats.stream_stat_get("kernel_recompiles",
+                                 "_namedtest") == 0
+    # with no named guard active, attribution falls back to the
+    # sink's stream as before
+    jax.jit(lambda x: x * 5 + 13)(jnp.zeros(5))
+    assert stats.stream_stat_get("kernel_recompiles",
+                                 "_namedtest") >= 1
+    assert stats.stream_stat_get("kernel_recompiles",
+                                 "q-attr-1") == named
+
+
+def test_compile_family_attribution_via_kernel_family():
+    """A compile triggered inside a kernel_family scope lands in the
+    factory_recompiles counter under that family."""
+    import jax
+    import jax.numpy as jnp
+
+    from hstream_tpu.common.tracing import (
+        install_recompile_counter,
+        kernel_family,
+    )
+    from hstream_tpu.stats import StatsHolder
+
+    stats = StatsHolder()
+    install_recompile_counter(stats, stream="_famtest")
+    seen = []
+    with kernel_family("probe", lambda fam, s: seen.append((fam, s))):
+        jax.jit(lambda x: x - 21)(jnp.zeros(7))
+    assert stats.stream_stat_get("factory_recompiles", "probe") >= 1
+    assert seen and seen[0][0] == "probe" and seen[0][1] >= 0.0
 
 
 # ---- waivers / baseline / framework ----------------------------------------

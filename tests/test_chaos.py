@@ -590,6 +590,63 @@ def test_session_device_activation_failure_stays_on_host_exactly():
     assert len(want) > 0
 
 
+def _health_verdict(stub, qid):
+    import json as _json
+
+    resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+        command="health", args=rec.dict_to_struct({"query": qid})))
+    return _json.loads(resp.result)
+
+
+def test_health_plane_ok_degraded_ok_across_session_fault():
+    """ISSUE 13 satellite: the health endpoint tracks a seeded
+    device.session.dispatch fault end to end — OK while the device
+    path is healthy, DEGRADED (reason device_fallback) once the
+    injected dispatch failure degrades the query to the host engine,
+    and OK again after the operator clears the fault and restarts the
+    query (fresh executor, device path re-activates)."""
+    server, ctx, stub, channel = _serve()
+    try:
+        qid, got = _session_flow("hps", "hpv", stub, ctx)
+        assert got  # real sessions closed — the query is doing work
+        h = _health_verdict(stub, qid)
+        assert h["verdict"] == "OK" and h["reasons"] == [], h
+        assert h["device_fallbacks"] == 0
+
+        # inject: the NEXT session step dispatch fails once -> the
+        # executor pulls back to the host engine (degrade, not die)
+        ctx.faults.arm("device.session.dispatch", "fail:1")
+        append_rows(stub, "hps", [{"user": "q", "v": 1.0}],
+                    [BASE + 120_000])
+        assert _wait(lambda: _health_verdict(
+            stub, qid)["verdict"] == "DEGRADED")
+        h = _health_verdict(stub, qid)
+        assert "device_fallback" in h["reasons"], h
+        assert h["level"] == 1 and h["device_fallbacks"] == 1
+        # the verdict gauge mirrors it for scrapers/the placer
+        assert ctx.stats.gauges_snapshot()[
+            ("query_health_level", qid)] == 1.0
+        # degraded, not dead — still RUNNING on the host path
+        assert ctx.persistence.get_query(qid).status == \
+            TaskStatus.RUNNING
+
+        # recover: clear the fault, operator restart -> fresh executor
+        # re-activates the device path -> OK
+        ctx.faults.disarm()
+        stub.TerminateQueries(pb.TerminateQueriesRequest(
+            query_ids=[qid]))
+        stub.RestartQuery(pb.RestartQueryRequest(id=qid))
+        wait_attached(ctx, qid)
+        append_rows(stub, "hps", [{"user": "r", "v": 2.0}],
+                    [BASE + 180_000])
+        assert _wait(lambda: _health_verdict(
+            stub, qid)["verdict"] == "OK")
+        h = _health_verdict(stub, qid)
+        assert h["device_fallbacks"] == 0, h
+    finally:
+        channel.close(); server.stop(grace=1); ctx.shutdown()
+
+
 # ---- the registry itself: determinism + hot-path discipline -----------------
 
 

@@ -39,7 +39,10 @@ GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
 
 @pytest.fixture(scope="module")
 def stack():
-    server, ctx = serve("127.0.0.1", 0, "mem://", metrics_port=0)
+    # trace_sample=1.0: every stamped request records spans (ISSUE 13
+    # roundtrip tests); everything else is unaffected
+    server, ctx = serve("127.0.0.1", 0, "mem://", metrics_port=0,
+                        trace_sample=1.0)
     addr = f"127.0.0.1:{ctx.port}"
     httpd, gw = serve_gateway(addr, port=0)
     http_base = f"http://127.0.0.1:{httpd.server_port}"
@@ -146,11 +149,29 @@ def _golden_holder() -> StatsHolder:
     stats.stream_stat_add("append_total", "s1", 3)
     stats.stream_stat_add("append_payload_bytes", "s1", 4096)
     stats.stream_stat_add("record_total", "s2", 7)
+    # freshness/attribution counters (ISSUE 13): late drops are
+    # query-labeled, factory recompiles family-labeled — both must
+    # render (and survive liveness filtering, asserted elsewhere)
+    stats.stream_stat_add("late_drops", "q1", 2)
+    stats.stream_stat_add("factory_recompiles", "step", 1)
+    stats.stream_stat_add("device_h2d_bytes", "s1", 1024)
+    stats.stream_stat_add("device_d2h_bytes", "s1", 512)
     stats.gauge_set("overload_level", "", 1)
     stats.gauge_set("running_queries", "", 2)
     stats.gauge_set("pipeline_occupancy", "q1", 0.5)
+    # freshness plane gauges (query-labeled)
+    stats.gauge_set("query_watermark_ms", "q1", 1_700_000_000_000)
+    stats.gauge_set("query_watermark_lag_ms", "q1", 250.0)
+    stats.gauge_set("query_health_level", "q1", 1)
     for v in (0.4, 3.0, 40.0):
         stats.observe("append_latency_ms", "s1", v)
+    # freshness histograms: per-stage lag + visible latency + emit
+    for stage, v in (("ingest", 4.0), ("engine", 30.0),
+                     ("delivery", 120.0)):
+        stats.observe("freshness_lag_ms", stage, v)
+    stats.observe("append_visible_latency_ms", "q1", 45.0)
+    stats.observe("emit_latency_ms", "q1", 12.0)
+    stats.observe("kernel_dispatch_ms", "step", 1.5)
     return stats
 
 
@@ -477,6 +498,347 @@ def test_query_tracer_carries_request_id(stack):
         time.sleep(0.05)
     assert task.tracer.summary()["request"]["id"] == "trace-rid-9"
     stub.DeleteQuery(pb.DeleteQueryRequest(id=q.id))
+
+
+# ---- freshness / trace spans / health plane (ISSUE 13) ---------------------
+
+
+def _append_rows(stub, stream, rows_ts, key="k"):
+    from hstream_tpu.common import records as rec
+
+    req = pb.AppendRequest(stream_name=stream)
+    for kval, ts in rows_ts:
+        req.records.append(rec.build_record({key: kval},
+                                            publish_time_ms=ts))
+    stub.Append(req)
+
+
+def _wait_watermark(ctx, qid, target, timeout=20):
+    from hstream_tpu.server.health import _executor_watermark
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        task = ctx.running_queries.get(qid)
+        if task is not None:
+            wm = _executor_watermark(task)
+            if wm is not None and wm >= target:
+                return task
+        time.sleep(0.05)
+    raise TimeoutError(f"query {qid} never reached watermark {target}")
+
+
+def test_trace_export_roundtrip(stack):
+    """Client -> gateway -> handler -> task spans share ONE trace id
+    (the request id), and the export is valid Chrome trace-event
+    JSON."""
+    addr, base, stub, ctx = stack
+    stub.CreateStream(pb.Stream(stream_name="trsrc"))
+    req = urllib.request.Request(
+        base + "/queries",
+        data=json.dumps({"sql": "SELECT k, COUNT(*) AS c FROM trsrc "
+                                "GROUP BY k, TUMBLING (INTERVAL 1 "
+                                "SECOND) GRACE BY INTERVAL 0 SECOND "
+                                "EMIT CHANGES;",
+                         "id": "qtr1"}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "trace-rt-7"})
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["id"] == "qtr1"
+    now = int(time.time() * 1000)
+    _append_rows(stub, "trsrc", [(f"k{i % 3}", now + i)
+                                 for i in range(32)])
+    _wait_watermark(ctx, "qtr1", now + 31)
+    code, body, _ = _http("GET", base, "/queries/qtr1/trace")
+    assert code == 200
+    trace = json.loads(body)
+    events = trace["traceEvents"]
+    assert events, "no spans exported"
+    assert {e["args"]["trace_id"] for e in events} == {"trace-rt-7"}
+    names = {e["name"] for e in events}
+    assert "rpc" in names, names          # the CreateQuery handler span
+    assert "step" in names, names         # the task's device-step span
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float))
+        assert e["dur"] >= 1
+        assert e["args"]["span_id"]
+    # the handler span parents the task's stage spans (one chain)
+    rpc = next(e for e in events if e["name"] == "rpc")
+    stage = next(e for e in events if e["name"] == "step")
+    assert stage["args"]["parent_id"] == rpc["args"]["span_id"]
+    # the gateway hop named itself as the handler span's parent
+    assert rpc["args"]["parent_id"] == "gw-trace-rt-7"
+    # admin trace --spans prints the same export as JSON
+    from hstream_tpu.admin import main as admin_main
+    import contextlib
+
+    host, port = addr.split(":")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = admin_main(["--host", host, "--port", port,
+                         "trace", "qtr1", "--spans"])
+    assert rc == 0
+    spans = json.loads(buf.getvalue().splitlines()[0])
+    assert spans["traceEvents"]
+    stub.DeleteQuery(pb.DeleteQueryRequest(id="qtr1"))
+
+
+def test_unsampled_requests_record_no_spans(stack):
+    """A request with no request id has no trace id, so nothing lands
+    in the rings even with tracing armed (sampling is per-trace and
+    deterministic)."""
+    addr, base, stub, ctx = stack
+    before = ctx.tracing.spans("_rpc")
+    stub.ListStreams(pb.ListStreamsRequest())  # bare: no metadata
+    assert ctx.tracing.spans("_rpc") == before
+
+
+def test_freshness_plane_on_live_server(stack):
+    """Watermark gauges, per-stage lag histograms, append->visible and
+    emit latency, kernel-family dispatch histograms, and the late-drop
+    counter all surface on /metrics from a live query."""
+    addr, base, stub, ctx = stack
+    stub.CreateStream(pb.Stream(stream_name="fpsrc"))
+    q = stub.CreateQuery(pb.CreateQueryRequest(
+        query_text="SELECT k, COUNT(*) AS c FROM fpsrc GROUP BY k, "
+                   "TUMBLING (INTERVAL 1 SECOND) GRACE BY INTERVAL 0 "
+                   "SECOND EMIT CHANGES;", id="qfp1"))
+    now = int(time.time() * 1000)
+    _append_rows(stub, "fpsrc", [(f"k{i % 4}", now + i)
+                                 for i in range(64)])
+    _wait_watermark(ctx, q.id, now + 63)
+    # one LATE record: past close at the current watermark
+    _append_rows(stub, "fpsrc", [("late", now - 3_600_000),
+                                 ("fresh", now + 100)])
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if ctx.stats.stream_stat_get("late_drops", q.id) >= 1:
+            break
+        time.sleep(0.05)
+    assert ctx.stats.stream_stat_get("late_drops", q.id) >= 1
+    code, body, _ = _http("GET", base, "/metrics")
+    text = body.decode()
+    assert f'hstream_query_watermark_ms{{query="{q.id}"}}' in text
+    assert f'hstream_query_watermark_lag_ms{{query="{q.id}"}}' in text
+    assert f'hstream_query_health_level{{query="{q.id}"}}' in text
+    assert 'hstream_freshness_lag_ms_bucket{stage="ingest"' in text
+    assert 'hstream_freshness_lag_ms_bucket{stage="engine"' in text
+    assert ('hstream_append_visible_latency_ms_bucket{consumer='
+            f'"{q.id}"') in text
+    assert f'hstream_emit_latency_ms_bucket{{query="{q.id}"' in text
+    assert 'hstream_kernel_dispatch_ms_bucket{family="step"' in text
+    assert re.search(
+        rf'hstream_late_drops_total\{{stream="{q.id}"\}} [1-9]', text)
+    stub.DeleteQuery(pb.DeleteQueryRequest(id=q.id))
+
+
+def test_delivery_stage_lag_from_subscription(stack):
+    addr, base, stub, ctx = stack
+    from hstream_tpu.common import records as rec
+
+    stub.CreateStream(pb.Stream(stream_name="dlsrc"))
+    req = pb.AppendRequest(stream_name="dlsrc")
+    req.records.append(rec.build_record({"a": 1}))
+    stub.Append(req)
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="dlsub", stream_name="dlsrc"))
+    got = stub.Fetch(pb.FetchRequest(subscription_id="dlsub",
+                                     timeout_ms=500, max_size=10))
+    assert got.received_records
+    code, body, _ = _http("GET", base, "/metrics")
+    text = body.decode()
+    assert 'hstream_freshness_lag_ms_bucket{stage="delivery"' in text
+    assert ('hstream_append_visible_latency_ms_bucket{consumer='
+            '"dlsub"') in text
+    stub.DeleteSubscription(pb.DeleteSubscriptionRequest(
+        subscription_id="dlsub"))
+
+
+def test_health_endpoint_ok_and_unknown(stack):
+    addr, base, stub, ctx = stack
+    stub.CreateStream(pb.Stream(stream_name="hlsrc"))
+    q = stub.CreateQuery(pb.CreateQueryRequest(
+        query_text="SELECT k, COUNT(*) AS c FROM hlsrc GROUP BY k, "
+                   "TUMBLING (INTERVAL 1 SECOND) EMIT CHANGES;",
+        id="qhl1"))
+    now = int(time.time() * 1000)
+    _append_rows(stub, "hlsrc", [("a", now)])
+    _wait_watermark(ctx, q.id, now)
+    code, body, _ = _http("GET", base, f"/queries/{q.id}/health")
+    assert code == 200
+    h = json.loads(body)
+    assert h["verdict"] == "OK" and h["level"] == 0, h
+    assert h["reasons"] == []
+    assert h["watermark_ms"] == now
+    assert h["thresholds"]["stalled_after_ms"] == 30000.0
+    # unknown query -> 404 through the typed-error mapping
+    try:
+        urllib.request.urlopen(base + "/queries/nope/health")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    stub.DeleteQuery(pb.DeleteQueryRequest(id=q.id))
+
+
+def test_health_stalled_crash_loop_journals_event(stack):
+    """A crash-looped query reads STALLED (reason crash_loop) and the
+    transition journals exactly one query_stalled event; operator
+    RestartQuery resets the breaker and health recovers."""
+    addr, base, stub, ctx = stack
+    stub.CreateStream(pb.Stream(stream_name="clsrc"))
+    q = stub.CreateQuery(pb.CreateQueryRequest(
+        query_text="SELECT k, COUNT(*) AS c FROM clsrc GROUP BY k, "
+                   "TUMBLING (INTERVAL 1 SECOND) EMIT CHANGES;",
+        id="qcl1"))
+    from helpers import wait_attached
+
+    task = wait_attached(ctx, q.id)
+    # kill the task for real (crash mode: no final snapshot, status
+    # stays RUNNING), then feed the supervisor a crash loop
+    task.stop(crash=True)
+    deadline = time.time() + 10
+    while q.id in ctx.running_queries and time.time() < deadline:
+        time.sleep(0.02)
+    assert q.id not in ctx.running_queries
+    info = ctx.persistence.get_query(q.id)
+    sup = ctx.supervisor
+    for _ in range(sup.BREAKER_K):
+        sup.note_death(info, RuntimeError("boom"))
+    assert q.id in sup.status()["breaker_open"]
+    seq0 = ctx.events.last_seq
+    code, body, _ = _http("GET", base, f"/queries/{q.id}/health")
+    h = json.loads(body)
+    assert h["verdict"] == "STALLED" and "crash_loop" in h["reasons"]
+    events = ctx.events.query(kind="query_stalled", since=seq0 - 50)
+    assert any(e.get("query") == q.id for e in events)
+    # re-evaluation does NOT re-journal (transition memory)
+    n_before = len(ctx.events.query(kind="query_stalled", limit=1000))
+    _http("GET", base, f"/queries/{q.id}/health")
+    assert len(ctx.events.query(kind="query_stalled",
+                                limit=1000)) == n_before
+    # operator restart closes the breaker; health recovers
+    stub.RestartQuery(pb.RestartQueryRequest(id=q.id))
+    code, body, _ = _http("GET", base, f"/queries/{q.id}/health")
+    h = json.loads(body)
+    assert h["verdict"] == "OK", h
+    stub.DeleteQuery(pb.DeleteQueryRequest(id=q.id))
+
+
+def test_health_unowned_only_when_this_node_owns(stack):
+    """A RUNNING query with no local task is STALLED(unowned) only
+    when the scheduler record names THIS node (or nobody) — a query
+    owned by a live peer is that peer's to judge, never false
+    distress from a bystander's scrape."""
+    import json as _json
+
+    from hstream_tpu.server import scheduler
+    from hstream_tpu.server.persistence import (
+        QueryInfo,
+        TaskStatus,
+        now_ms,
+    )
+
+    addr, base, stub, ctx = stack
+    info = QueryInfo(query_id="qpeer1", sql="SELECT 1;",
+                     created_time_ms=now_ms(),
+                     status=TaskStatus.RUNNING, sink="qpeer1")
+    ctx.persistence.insert_query(info)
+    try:
+        # owned by a live PEER (higher epoch): not ours to judge
+        ctx.config.put(
+            scheduler._key("qpeer1"),
+            _json.dumps({"node": "server-9@peer:6570",
+                         "epoch": ctx.boot_epoch + 1}).encode())
+        code, body, _ = _http("GET", base, "/queries/qpeer1/health")
+        h = json.loads(body)
+        assert h["verdict"] == "OK", h
+        assert h["owner"] == "server-9@peer:6570"
+        # re-owned by THIS node, still no task: genuinely unowned
+        cur = ctx.config.get(scheduler._key("qpeer1"))
+        ctx.config.put(
+            scheduler._key("qpeer1"),
+            _json.dumps({"node": scheduler.node_name(ctx),
+                         "epoch": ctx.boot_epoch}).encode(),
+            base_version=cur[0])
+        code, body, _ = _http("GET", base, "/queries/qpeer1/health")
+        h = json.loads(body)
+        assert h["verdict"] == "STALLED" and "unowned" in h["reasons"]
+    finally:
+        ctx.persistence.remove_query("qpeer1")
+        cur = ctx.config.get(scheduler._key("qpeer1"))
+        if cur is not None:
+            ctx.config.delete(scheduler._key("qpeer1"),
+                              base_version=cur[0])
+
+
+def test_host_device_session_freshness_parity():
+    """The freshness plane reads the same host-mirror values whichever
+    engine ran the batch: device and host session executors agree on
+    the watermark AND the late-drop count for an identical feed."""
+    import numpy as np
+
+    from hstream_tpu.engine import ColumnType, Schema
+    from hstream_tpu.engine.expr import Col
+    from hstream_tpu.engine.plan import (
+        AggKind,
+        AggregateNode,
+        AggSpec,
+        SourceNode,
+    )
+    from hstream_tpu.engine.session import SessionExecutor
+    from hstream_tpu.engine.window import SessionWindow
+
+    def mk():
+        schema = Schema.of(u=ColumnType.STRING, v=ColumnType.FLOAT)
+        node = AggregateNode(
+            child=SourceNode("s", schema), group_keys=[Col("u")],
+            window=SessionWindow(1_000, grace_ms=0),
+            aggs=[AggSpec(AggKind.COUNT_ALL, "c")])
+        return SessionExecutor(node, schema, emit_changes=False)
+
+    dev, host = mk(), mk()
+    host.use_device_sessions = False
+    base = 1_700_000_000_000
+    users = np.array(["a", "b", "c", "d"])
+    feeds = [
+        (base + np.arange(8, dtype=np.int64) * 100,
+         {"u": users[np.arange(8) % 4], "v": np.ones(8, np.float32)}),
+        # far ahead: closes the first sessions and advances the wm
+        (base + 60_000 + np.arange(8, dtype=np.int64) * 100,
+         {"u": users[np.arange(8) % 4], "v": np.ones(8, np.float32)}),
+        # LATE: all 8 records are past gap+grace at the watermark
+        (base + 10_000 + np.arange(8, dtype=np.int64),
+         {"u": users[np.arange(8) % 4], "v": np.ones(8, np.float32)}),
+    ]
+    out_dev, out_host = [], []
+    for ts, cols in feeds:
+        out_dev.extend(dev.process_columnar(ts, dict(cols)))
+        out_host.extend(host.process_columnar(ts, dict(cols)))
+    out_dev.extend(dev.drain_closed())
+    out_host.extend(host.drain_closed())
+    assert dev._dev is not None, "device path did not activate"
+    assert dev.watermark == host.watermark
+    assert dev.late_drops == host.late_drops == 8
+    assert len(out_dev) == len(out_host)
+
+
+def test_query_label_counters_survive_stream_filter():
+    """late_drops / kernel_recompiles series are query-labeled: the
+    live-STREAM filter must not drop them (bounded by query existence
+    instead), and factory_recompiles is never liveness-filtered."""
+    stats = StatsHolder()
+    stats.stream_stat_add("late_drops", "q9", 4)
+    stats.stream_stat_add("kernel_recompiles", "q9", 2)
+    stats.stream_stat_add("factory_recompiles", "probe", 1)
+    text = render_holder(stats, live_streams=set(), live_queries={"q9"})
+    assert 'hstream_late_drops_total{stream="q9"} 4' in text
+    assert 'hstream_kernel_recompiles_total{stream="q9"} 2' in text
+    assert 'hstream_factory_recompiles_total{stream="probe"} 1' in text
+    # deleted query: its series leave the exposition
+    text = render_holder(stats, live_streams=set(), live_queries=set())
+    assert "q9" not in text
+    assert 'hstream_factory_recompiles_total{stream="probe"} 1' in text
 
 
 # ---- /overview wiring (satellite) ------------------------------------------

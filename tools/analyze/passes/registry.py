@@ -38,6 +38,11 @@ RULES = {
     "registry-dead": (
         "registered metric/event kind referenced by no production "
         "call site — a dead registry entry"),
+    "registry-stage": (
+        "trace-span stage / kernel-family literal absent from the "
+        "declared sets (tracing.TRACE_STAGES / KERNEL_FAMILIES) — a "
+        "renamed stage silently orphans its histogram series and its "
+        "spans"),
 }
 
 COUNTER_CALLS = {"stream_stat_add", "stream_stat_get",
@@ -46,6 +51,20 @@ TS_CALLS = {"time_series_add", "time_series_get_rate",
             "time_series_peek_rate", "time_series_streams", "_ts"}
 GAUGE_CALLS = {"gauge_set", "gauge_fn", "gauge_drop", "gauge_labels"}
 HIST_CALLS = {"observe", "histogram_percentile", "_hist"}
+
+# stage/family-literal call shapes (ISSUE 13): call name -> (positional
+# index of the stage literal, declared-set kind). The spans and the
+# stage-labeled histogram series both key on these names, so a rename
+# at one call site silently forks the series.
+STAGE_ARG_CALLS = {
+    "trace_span": (1, "stage"),
+    "record_span": (1, "stage"),
+    "_observe_append_stage": (0, "stage"),
+    "_trace_stage_span": (1, "stage"),
+    "kernel_family": (0, "family"),
+}
+# histograms whose LABEL argument is a stage name
+STAGE_LABELED_HISTOGRAMS = {"stage_latency_ms", "freshness_lag_ms"}
 
 # files whose literals do NOT count as "referenced" for the dead-entry
 # check: the registries themselves, the exposition layer (HELP text
@@ -65,6 +84,7 @@ def _registries(repo: str) -> dict[str, set[str]]:
     """Import the live registries from the tree under analysis."""
     if repo not in sys.path:
         sys.path.insert(0, repo)
+    from hstream_tpu.common.tracing import KERNEL_FAMILIES, TRACE_STAGES
     from hstream_tpu.stats import (
         GAUGES,
         HISTOGRAMS,
@@ -79,6 +99,11 @@ def _registries(repo: str) -> dict[str, set[str]]:
         "gauge": set(GAUGES),
         "histogram": {name for name, _b, _l in HISTOGRAMS},
         "event": set(EVENT_KINDS),
+        # stage/family vocabularies are checked in the UNKNOWN
+        # direction only: their names are common words, so a literal
+        # scan cannot prove deadness
+        "stage": set(TRACE_STAGES),
+        "family": set(KERNEL_FAMILIES),
     }
 
 
@@ -135,11 +160,38 @@ def run(files, repo) -> list[Finding]:
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
+            name = _method_name(node)
+            # stage/family literals sit at varying positions; dynamic
+            # names are skipped like every other registry check
+            ent = STAGE_ARG_CALLS.get(name or "")
+            if ent is not None:
+                idx, skind = ent
+                if len(node.args) > idx:
+                    arg = node.args[idx]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)
+                            and arg.value not in registries[skind]):
+                        out.append(Finding(
+                            "registry-stage", src.rel, node.lineno,
+                            f"{name}(... {arg.value!r} ...) names an "
+                            f"undeclared {skind} (tracing."
+                            f"{'TRACE_STAGES' if skind == 'stage' else 'KERNEL_FAMILIES'})"))
             first = node.args[0]
             if not (isinstance(first, ast.Constant)
                     and isinstance(first.value, str)):
                 continue  # dynamic name: runtime KeyError covers it
-            name = _method_name(node)
+            if (name in HIST_CALLS
+                    and first.value in STAGE_LABELED_HISTOGRAMS
+                    and len(node.args) > 1):
+                lab = node.args[1]
+                if (isinstance(lab, ast.Constant)
+                        and isinstance(lab.value, str)
+                        and lab.value not in registries["stage"]):
+                    out.append(Finding(
+                        "registry-stage", src.rel, node.lineno,
+                        f"{name}({first.value!r}, {lab.value!r}, ...) "
+                        f"labels a stage histogram with an undeclared "
+                        f"stage (tracing.TRACE_STAGES)"))
             kind = _CALL_KIND.get(name or "")
             if kind is not None:
                 metric = first.value
@@ -159,8 +211,11 @@ def run(files, repo) -> list[Finding]:
                         "registry-unknown", src.rel, node.lineno,
                         f"events.append({event!r}) names an "
                         f"unregistered event kind"))
-    # direction 2: registered but never referenced anywhere
+    # direction 2: registered but never referenced anywhere (stage/
+    # family vocabularies excluded — see _registries)
     for kind, names in sorted(registries.items()):
+        if kind in ("stage", "family"):
+            continue
         for name in sorted(names - referenced[kind]):
             out.append(Finding(
                 "registry-dead", REGISTRY_FILE, 1,
