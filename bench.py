@@ -1411,10 +1411,22 @@ def smoke_main() -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
+    # disarmed-witness contract (ISSUE 14): the whole smoke — incl.
+    # the SERVER run over the instrumented append-front/task/
+    # subscription locks — executes with the lock-order witness
+    # disarmed, and must leave it with ZERO state: no held-set, no
+    # graph edges, no per-lock accounting. A regression here means a
+    # TracedLock started paying witness bookkeeping on the disarmed
+    # path (the one-attribute-read + one-branch contract broke).
+    from hstream_tpu.common.locktrace import LOCKTRACE
+
+    assert not LOCKTRACE.active, "smoke must run witness-disarmed"
     tumbling = _smoke_run(_smoke_tumbling_config)
     join = _smoke_run(_smoke_join_config)
     session = _smoke_run(_smoke_session_config)
     server_columnar = _smoke_server_columnar()
+    lock_edges = LOCKTRACE.edge_count()
+    lock_state = len(LOCKTRACE.status()["locks"])
     result = {
         "metric": "recompiles_per_run",
         "mode": "smoke",
@@ -1423,6 +1435,8 @@ def smoke_main() -> None:
         "join_recompiles": join,
         "session_recompiles": session,
         "server_columnar_recompiles": server_columnar,
+        "locktrace_disarmed_edges": lock_edges,
+        "locktrace_disarmed_locks": lock_state,
         "batches": 50,
         "platform": jax.devices()[0].platform,
     }
@@ -1430,6 +1444,11 @@ def smoke_main() -> None:
     if tumbling or join or session or server_columnar:
         print("# retrace gate FAILED: steady-state batches compiled "
               "new XLA executables", flush=True)
+        sys.exit(1)
+    if lock_edges or lock_state:
+        print("# locktrace gate FAILED: the DISARMED witness recorded "
+              "state — the one-branch disarmed contract broke",
+              flush=True)
         sys.exit(1)
 
 

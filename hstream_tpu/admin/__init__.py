@@ -271,6 +271,40 @@ def cmd_fault(stub, args) -> list[dict]:
     return _admin(stub, "fault-clear", site=args.site)
 
 
+def cmd_locks(stub, args) -> list[dict]:
+    """Lock-order witness ledger (ISSUE 14): named locks with
+    acquire/contention counts and wait/hold percentiles, the observed
+    order graph, and any detected cycles; --arm/--disarm flip the
+    witness at runtime."""
+    kwargs = {}
+    if args.arm:
+        kwargs["action"] = "arm"
+    elif args.disarm:
+        kwargs["action"] = "disarm"
+    out = _admin(stub, "locks", **kwargs)
+    st = out[0] if out else {}
+    rows = [{"lock": "(witness)",
+             "value": "armed" if st.get("armed") else "disarmed",
+             "detail": f"cycles={len(st.get('cycles', []))}"}]
+    for name, row in sorted((st.get("locks") or {}).items()):
+        detail = " ".join(
+            f"{k}={row[k]}" for k in ("wait_p50_ms", "wait_p99_ms",
+                                      "hold_p50_ms", "hold_p99_ms")
+            if row.get(k) is not None)
+        rows.append({"lock": name,
+                     "value": f"acq={row.get('acquires', 0)} "
+                              f"cont={row.get('contentions', 0)}",
+                     "detail": detail or "-"})
+    for a, bs in sorted((st.get("edges") or {}).items()):
+        rows.append({"lock": f"order {a}",
+                     "value": "->", "detail": ",".join(bs)})
+    for c in st.get("cycles") or []:
+        ring = " -> ".join(e[0] for e in c.get("ring", []))
+        rows.append({"lock": "CYCLE", "value": ring,
+                     "detail": str(c.get("witness", ""))[:60]})
+    return rows
+
+
 def cmd_supervisor(stub, args) -> list[dict]:
     """Query-supervision status: pending restarts + open breakers."""
     resp = _admin(stub, "supervisor")
@@ -404,6 +438,14 @@ def main(argv=None) -> int:
     sub.add_parser("supervisor",
                    help="query supervision: pending restarts and "
                         "crash-loop breakers")
+    p = sub.add_parser("locks",
+                       help="lock-order witness: named locks, wait/"
+                            "hold p50/p99, contention, order graph, "
+                            "cycle reports")
+    p.add_argument("--arm", action="store_true",
+                   help="arm the witness at runtime")
+    p.add_argument("--disarm", action="store_true",
+                   help="disarm and forget witness state")
     args = ap.parse_args(argv)
 
     fn = globals()[f"cmd_{args.cmd.replace('-', '_')}"]

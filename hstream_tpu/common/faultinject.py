@@ -38,6 +38,13 @@ Spec grammar (env var ``HSTREAM_FAULTS``, admin ``fault-set``, tests):
     prob:P[:SEED]     raise with probability P per hit (seeded RNG)
     delay:MS[:N]      sleep MS milliseconds on every hit (or only hit N)
     torn:N[:SEED]     mutate(): truncate the Nth write at a seeded point
+    yield:N[:SEED]    interleaving perturber (ISSUE 14): on ~1/N of
+                      hits (seeded RNG, deterministic decision stream)
+                      sleep a seeded sub-millisecond jitter — a forced
+                      scheduler yield that explores adversarial thread
+                      interleavings at the site; armed at the
+                      lock.acquire.* sites with the locktrace witness,
+                      this is the seeded schedule-perturbation harness
 
 ``HSTREAM_FAULTS="store.append=fail:3;snapshot.persist=torn:2:7"``
 arms two sites for the whole process. The registry is process-global
@@ -68,6 +75,10 @@ Instrumented sites (the registry accepts any name; these exist today):
     device.session.activate session arena activation + host migration
     task.step               query-task ingest of one read chunk
     rpc.handler             unary gRPC handler entry
+    lock.acquire.<name>     every TracedLock acquire (common/locktrace):
+                            one site per lock ROLE — appendfront.submit,
+                            scheduler.supervisor, tasks.state, ... —
+                            the natural home of yield: schedules
 """
 
 from __future__ import annotations
@@ -140,9 +151,19 @@ class _Site:
             self.seed = int(parts[2]) if len(parts) > 2 else 0
             self.count = 1
             self._rng = random.Random(self.seed)
+        elif self.kind == "yield":
+            if len(parts) < 2:
+                raise ValueError(f"yield needs N: {spec!r}")
+            n = int(parts[1])
+            if n < 1:
+                raise ValueError(f"yield N must be >= 1: {spec!r}")
+            self.arg = n
+            self.seed = int(parts[2]) if len(parts) > 2 else 0
+            self.count = 0
+            self._rng = random.Random(self.seed)
         else:
             raise ValueError(f"unknown fault kind {self.kind!r} "
-                             f"(fail/prob/delay/torn)")
+                             f"(fail/prob/delay/torn/yield)")
 
     def fire(self) -> tuple[str, float] | None:
         """Advance the schedule one point() hit. Returns None (no
@@ -164,6 +185,16 @@ class _Site:
             if self.count == 0 or self.hits == self.count:
                 self.injected += 1
                 return ("delay", self.arg)
+        elif self.kind == "yield":
+            # two seeded draws per hit: the 1/N decision, then the
+            # jitter magnitude — one deterministic stream per spec, so
+            # a seed replays the same perturbation SEQUENCE even when
+            # threads race for the next decision
+            r = self._rng.random()
+            jitter = self._rng.random()
+            if r < 1.0 / self.arg:
+                self.injected += 1
+                return ("yield", jitter * 0.002)
         return None
 
     def tear(self, data: bytes) -> bytes | None:
@@ -269,6 +300,11 @@ class FaultRegistry:
         kind, arg = fired
         if kind == "delay":
             self._journal(site, s, "delay")
+            time.sleep(arg)
+            return
+        if kind == "yield":
+            # no journal: a perturbation run yields thousands of times
+            # and the journal ring must keep the interesting events
             time.sleep(arg)
             return
         self._journal(site, s, "fail")

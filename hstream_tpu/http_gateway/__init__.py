@@ -45,6 +45,7 @@ from typing import Any
 
 import grpc
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.logger import (
     REQUEST_ID_KEY,
@@ -106,7 +107,9 @@ class Gateway:
     def __init__(self, server_addr: str):
         self.server_addr = server_addr
         self.leader_follows = 0  # rebinds performed after a hint
-        self._bind_lock = threading.Lock()
+        # named traced lock (ISSUE 14): the rebind-once channel swap is
+        # the gateway's one cross-thread rendezvous — witness-covered
+        self._bind_lock = locktrace.lock("gateway.bind")
         self.channel = grpc.insecure_channel(server_addr)
         self.stub = _CorrelatedStub(HStreamApiStub(self.channel))
         # channels replaced by a leader-hint rebind, closed only at

@@ -28,6 +28,7 @@ import threading
 from concurrent.futures import Future
 from typing import Sequence
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.store.api import Compression
 
 # a lane worker that cannot keep up holds at most this many pending
@@ -49,14 +50,18 @@ class AppendFront:
         self._closed = False
         self.submitted = 0   # batches handed to the front
         self.completed = 0   # batches resolved (ok or error)
-        self._stat_lock = threading.Lock()
+        # named traced locks (ISSUE 14): the lock-order witness sees
+        # every acquire when armed; disarmed cost is one attribute
+        # read + one branch per acquire (hot-path contract below)
+        self._stat_lock = locktrace.lock("appendfront.stat")
         # serializes the closed-check + enqueue against close(): without
         # it a submit racing shutdown could land its item AFTER the
         # close sentinel and leave its Future unresolved forever
-        self._submit_lock = threading.Lock()
+        self._submit_lock = locktrace.lock("appendfront.submit")
         # per-lane enqueue locks: backpressure on one lane must not
         # head-of-line-block submissions to the others
-        self._lane_locks = [threading.Lock() for _ in range(self.lanes)]
+        self._lane_locks = locktrace.lock_list("appendfront.lane",
+                                               self.lanes)
         if not self._async:
             for i in range(self.lanes):
                 q: queue.Queue = queue.Queue(maxsize=LANE_DEPTH)
@@ -112,6 +117,13 @@ class AppendFront:
                 with self._stat_lock:
                     self.submitted -= 1
                 raise RuntimeError("append front is closed")
+            # deliberate per-lane backpressure: a lane at depth blocks
+            # ONLY its own stream's submitters on the lane lock; the
+            # worker holds no lock while draining, so the put always
+            # unblocks at store speed, and close() (which queues
+            # behind this lock only for the sentinel insert) is
+            # bounded the same way
+            # analyze: ok wait-holding — see rationale above
             self._queues[lane].put(
                 (logid, payloads, compression, fut))
         return fut

@@ -67,7 +67,9 @@ class ServerContext:
         # query_id -> QueryTask; connector_id -> ConnectorTask
         self.running_queries: dict[str, object] = {}
         self.running_connectors: dict[str, object] = {}
-        self.lock = threading.Lock()
+        from hstream_tpu.common import locktrace
+
+        self.lock = locktrace.lock("context.running")
         self.host = host
         self.port = port
         self.server_id = server_id
@@ -128,7 +130,7 @@ class ServerContext:
         # producer-stamped appends on a NON-replicated store serialize
         # their lookup+append+record through this lock (the replicated
         # store has its own critical section; store/dedup.py)
-        self.dedup_lock = threading.Lock()
+        self.dedup_lock = locktrace.lock("context.dedup")
         # wire-speed ingest (ISSUE 12): framed columnar appends go
         # through sharded lanes feeding the store's completion-queue
         # path, so the RPC thread validates the NEXT block while the
@@ -160,6 +162,17 @@ class ServerContext:
         self.faults = FAULTS
         FAULTS.bind_events(self.events)
         FAULTS.load_env()
+        # lock-order witness (ISSUE 14): the named traced locks above
+        # (append front, supervisor, subscriptions, tasks, replica,
+        # gateway) report into this registry when armed — per-lock
+        # wait/hold histograms + contention on /metrics, lock_cycle
+        # events in the journal, `admin locks` for the ledger.
+        # HSTREAM_LOCKTRACE=1 / --locktrace arms it for the process.
+        from hstream_tpu.common.locktrace import LOCKTRACE
+
+        self.locktrace = LOCKTRACE
+        LOCKTRACE.bind(stats=self.stats, events=self.events)
+        LOCKTRACE.load_env()
         # self-healing supervision: tasks report unexpected deaths here;
         # the servicer binds resume_fn once handlers exist
         from hstream_tpu.server.scheduler import QuerySupervisor

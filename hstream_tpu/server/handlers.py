@@ -1235,6 +1235,24 @@ class HStreamApiServicer:
                 out = _health.evaluate_query(ctx, str(q))
             else:
                 out = _health.evaluate_all(ctx)  # qid -> health dict
+        elif cmd == "locks":
+            # lock-order witness ledger (ISSUE 14): armed state,
+            # per-lock acquire/contention counts + wait/hold p50/p99
+            # (from the bound histograms), the observed order graph,
+            # and any detected cycles. arm/disarm flips the witness
+            # at runtime like fault-set does for the chaos registry.
+            lt = getattr(ctx, "locktrace", None)
+            if lt is None:
+                from hstream_tpu.common.locktrace import LOCKTRACE as lt
+            action = str(args.get("action") or "")
+            if action == "arm":
+                lt.arm()
+            elif action == "disarm":
+                lt.disarm()
+            elif action:
+                raise ServerError(
+                    f"unknown locks action {action!r} (arm/disarm)")
+            out = lt.status()
         elif cmd == "trace-spans":
             # one scope's span ring as Chrome trace-event JSON
             # (GET /queries/<id>/trace, `admin trace --spans`)

@@ -54,6 +54,7 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           metrics_port: int | None = None,
           slow_request_ms: float = 1000.0,
           faults: str | None = None,
+          locktrace: bool = False,
           trace_sample: float = 0.0,
           health_degraded_ms: float | None = None,
           health_stalled_ms: float | None = None
@@ -96,6 +97,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
         # chaos harness: arm fault sites for this run (same grammar as
         # HSTREAM_FAULTS, which ServerContext already loaded)
         ctx.faults.load_env(faults)
+    if locktrace:
+        # lock-order witness (ISSUE 14): arm the runtime deadlock
+        # detector for this process (HSTREAM_LOCKTRACE=1 equivalent)
+        ctx.locktrace.arm()
     if append_compression:
         from hstream_tpu.store.api import Compression
 
@@ -209,6 +214,14 @@ def _parse_args(argv):
                          "'store.append=fail:3;snapshot.persist="
                          "torn:2:7' (also: HSTREAM_FAULTS env, admin "
                          "fault-set at runtime)")
+    ap.add_argument("--locktrace", action="store_true", default=None,
+                    help="arm the runtime lock-order witness "
+                         "(GoodLock/lockdep): per-thread held-sets, "
+                         "cycle detection journaling lock_cycle, "
+                         "lock_wait_ms/lock_hold_ms/lock_contention "
+                         "on /metrics, `admin locks` ledger; also: "
+                         "HSTREAM_LOCKTRACE=1 env. Disarmed cost is "
+                         "one attribute read + one branch per acquire")
     ap.add_argument("--trace-sample", type=float, default=None,
                     help="cross-component span sampling rate in [0,1]: "
                          "0 disarms tracing (one-branch cost), 1 "
@@ -239,6 +252,7 @@ def _parse_args(argv):
                 "metrics_port": None,
                 "slow_request_ms": 1000.0,
                 "faults": None,
+                "locktrace": False,
                 "trace_sample": 0.0,
                 "health_degraded_ms": None,
                 "health_stalled_ms": None}
@@ -285,6 +299,7 @@ def main(argv=None) -> None:
         metrics_port=cfg["metrics_port"],
         slow_request_ms=cfg["slow_request_ms"],
         faults=cfg["faults"],
+        locktrace=cfg["locktrace"],
         trace_sample=cfg["trace_sample"],
         health_degraded_ms=cfg["health_degraded_ms"],
         health_stalled_ms=cfg["health_stalled_ms"])

@@ -34,6 +34,7 @@ import threading
 import time
 from collections import deque
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.common.backoff import jittered_backoff
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.store.versioned import VersionMismatch
@@ -228,7 +229,11 @@ class QuerySupervisor:
         self.resume_fn = resume_fn
         self.clock = clock
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        # named traced lock (ISSUE 14): the supervisor's pending/
+        # breaker tables are a cross-object rendezvous (tasks report
+        # deaths, handlers cancel, the restart thread dispatches) —
+        # exactly where the lock-order witness earns its keep
+        self._lock = locktrace.lock("scheduler.supervisor")
         self._wake = threading.Event()
         self._stopped = False
         # qid -> (due monotonic ts, QueryInfo, attempt#)

@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass
 from typing import Any
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.common.errors import (
     SubscriptionExists,
     SubscriptionNotFound,
@@ -194,7 +195,9 @@ class SubscriptionRuntime:
         self.sub_id = meta.subscription_id
         self.logid = ctx.streams.get_logid(meta.stream_name)
         self.window = AckWindow()
-        self.lock = threading.Lock()
+        # named traced lock (ISSUE 14): fetch/ack/dispatch/shutdown
+        # all rendezvous here — witness-instrumented
+        self.lock = locktrace.lock("subscriptions.runtime")
         self._reader: CheckpointedReader | None = None
         self._committed: int = 0
         # streaming-fetch state
@@ -501,7 +504,7 @@ class SubscriptionRuntime:
 class SubscriptionRegistry:
     def __init__(self) -> None:
         self._subs: dict[str, SubscriptionRuntime] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.lock("subscriptions.registry")
 
     def create(self, ctx, meta) -> SubscriptionRuntime:
         with self._lock:

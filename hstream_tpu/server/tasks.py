@@ -30,7 +30,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from hstream_tpu.common import columnar, jsondec
+from hstream_tpu.common import columnar, jsondec, locktrace
 from hstream_tpu.common import records as rec
 from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
@@ -113,8 +113,12 @@ class QueryTask(threading.Thread):
         self.executor = None
         self.error: BaseException | None = None
         # serializes executor state mutation (this thread) against pull
-        # queries peeking live state from gRPC threads (views.snapshot)
-        self.state_lock = threading.RLock()
+        # queries peeking live state from gRPC threads (views.snapshot).
+        # Named + traced (ISSUE 14): this is the busiest cross-object
+        # lock in the server — the canonical order (tasks.state before
+        # views.materialization / pipeline internals) is what the
+        # armed witness certifies
+        self.state_lock = locktrace.rlock("tasks.state")
         # optional sink-side state riding in the snapshot (a view's
         # closed-row materialization survives restarts this way)
         self.sink_dump: Callable[[], Any] | None = None
@@ -157,7 +161,10 @@ class QueryTask(threading.Thread):
         self._last_snapshot_ms = 0.0
         self._last_persist_ms = 0.0   # cost of the last state write
         self._last_inline_ms = 0.0    # capture-side stall of last snap
-        self._persist_cv = threading.Condition()
+        # condition over a traced re-entrant lock: waits release the
+        # lock through the wrapper, so the held-set stays truthful
+        self._persist_cv = threading.Condition(
+            locktrace.rlock("tasks.persist"))
         self._persist_pending = None  # latest un-persisted capture
         self._persist_busy = False
         self._persist_stop = False

@@ -17,10 +17,10 @@ structured fields (richer than the reference's string labels).
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.common.errors import ViewNotFound
 from hstream_tpu.engine.expr import eval_host
 from hstream_tpu.sql import ast
@@ -41,7 +41,11 @@ class Materialization:
         self._closed: OrderedDict[tuple, dict[str, Any]] = OrderedDict()
         self._max = max_closed_rows
         self._seq = 0
-        self._lock = threading.Lock()
+        # named traced lock (ISSUE 14): the canonical order is
+        # tasks.state BEFORE views.materialization (sink under the
+        # task's lock; snapshot takes state_lock first for the same
+        # reason) — the armed witness certifies it at runtime
+        self._lock = locktrace.lock("views.materialization")
         self.task = None  # set by the owner; .executor gives live state
 
     def _row_key(self, row: dict[str, Any]) -> tuple:
@@ -98,7 +102,7 @@ class ViewRegistry:
 
     def __init__(self) -> None:
         self._views: dict[str, Materialization] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.lock("views.registry")
 
     def register(self, name: str, mat: Materialization) -> None:
         with self._lock:

@@ -70,6 +70,9 @@ PER_STREAM_COUNTERS = [
                                # the kernel family whose dispatch
                                # triggered them (label: step/close/
                                # probe/session)
+    "lock_contention",         # traced-lock acquires that found the
+                               # lock taken (locktrace witness armed;
+                               # label: lock role name)
 ]
 
 PER_STREAM_TIME_SERIES = [
@@ -138,6 +141,10 @@ HISTOGRAMS = [
     ("freshness_lag_ms", FRESHNESS_BUCKETS_MS, "stage"),
     # per-kernel-family host dispatch time (step/close/probe/session)
     ("kernel_dispatch_ms", LATENCY_BUCKETS_MS, "family"),
+    # lock-order witness ledger (ISSUE 14): time spent waiting for /
+    # holding each named traced lock, armed runs only
+    ("lock_wait_ms", LATENCY_BUCKETS_MS, "lock"),
+    ("lock_hold_ms", LATENCY_BUCKETS_MS, "lock"),
 ]
 
 _HIST_BUCKETS = {name: buckets for name, buckets, _label in HISTOGRAMS}

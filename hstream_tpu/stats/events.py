@@ -48,6 +48,10 @@ EVENT_KINDS = [
                          # watermark progress, crash loop, or a dead
                          # unowned task) — the machine-readable signal
                          # failover adoption and the placer gate on
+    "lock_cycle",        # the runtime lock-order witness (locktrace)
+                         # saw both directions of a lock pair — a
+                         # potential deadlock reported WITHOUT needing
+                         # the unlucky schedule (GoodLock)
 ]
 
 

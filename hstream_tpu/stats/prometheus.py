@@ -45,6 +45,11 @@ QUERY_LABEL_COUNTERS = frozenset({"query_restarts", "snapshot_fallbacks",
 # and query namespaces (kernel families): never liveness-filtered
 FAMILY_LABEL_COUNTERS = frozenset({"factory_recompiles"})
 
+# counters labeled by a traced-lock ROLE name (locktrace witness):
+# lock roles are a small closed set named in code, not streams —
+# the liveness filter must not drop them (ISSUE 14)
+LOCK_LABEL_COUNTERS = frozenset({"lock_contention"})
+
 _HELP = {
     "append_payload_bytes": "bytes appended (payload only)",
     "append_total": "append batches accepted",
@@ -121,6 +126,12 @@ _HELP = {
                         "(ingest / engine / delivery)",
     "kernel_dispatch_ms": "host dispatch time per kernel family "
                           "(step / close / probe / session)",
+    "lock_contention": "traced-lock acquires that found the lock "
+                       "taken (lock-order witness armed)",
+    "lock_wait_ms": "time spent waiting to acquire each named traced "
+                    "lock (lock-order witness armed)",
+    "lock_hold_ms": "time each named traced lock was held per "
+                    "critical section (lock-order witness armed)",
 }
 
 
@@ -175,7 +186,8 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
             # STREAM liveness filter must not drop them — query-
             # labeled series are bounded by query existence instead
             if not stream.startswith("_") \
-                    and metric not in FAMILY_LABEL_COUNTERS:
+                    and metric not in FAMILY_LABEL_COUNTERS \
+                    and metric not in LOCK_LABEL_COUNTERS:
                 if metric in QUERY_LABEL_COUNTERS:
                     if (live_queries is not None
                             and stream not in live_queries):

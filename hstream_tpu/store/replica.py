@@ -61,6 +61,7 @@ from typing import Sequence
 
 import grpc
 
+from hstream_tpu.common import locktrace
 from hstream_tpu.common.backoff import jittered_backoff
 from hstream_tpu.common.errors import (
     NotLeaderError,
@@ -443,7 +444,10 @@ class ReplicatedStore(LogStore):
         # optional StatsHolder (bound by ServerContext, like journal)
         self.stats = None
         self._stop = threading.Event()
-        self._cond = threading.Condition()
+        # condition over a named traced re-entrant lock (ISSUE 14):
+        # the op-log sequence, sender wakeups, and ack waits all
+        # rendezvous here — the leader half of the witness graph
+        self._cond = threading.Condition(locktrace.rlock("replica.oplog"))
         self._broken: BaseException | None = None
         # durability introspection: status of the most recent acked
         # append ("replicated" | "degraded:followers_down" |
@@ -966,7 +970,9 @@ class FollowerService:
         # not HStreamApi — a followed client would then fail
         # UNIMPLEMENTED instead of reaching a SQL surface
         self.advertise_addr = advertise_addr
-        self._lock = threading.Lock()
+        # named traced lock (ISSUE 14): epoch/fencing/bind state — the
+        # follower half of the replica witness graph
+        self._lock = locktrace.lock("replica.follower")
         self._broken: BaseException | None = None
         # the accepted leader binding is DURABLE (store meta): a
         # restarted follower must keep rejecting a stale leader instead

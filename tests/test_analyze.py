@@ -28,16 +28,19 @@ from tools.analyze import (  # noqa: E402
     write_baseline,
 )
 from tools.analyze.passes import (  # noqa: E402
+    atomicity,
     blocking,
     dispatch,
     errcontract,
     lifecycle,
+    lockorder,
     locks,
     overflow,
     purity,
     registry,
     retrace,
     shardmap,
+    waitholding,
 )
 
 
@@ -180,6 +183,308 @@ def test_lock_order_inversion_flagged():
     out = run_one(locks, [src("m.py", code)])
     assert rules_of(out) == {"lock-order"}
     assert len(out) == 2  # both sites named
+
+
+# ---- lockorder: whole-program cycle (ISSUE 14) -----------------------------
+
+
+# the seeded CROSS-CLASS inversion the per-class rule cannot see: the
+# task calls into the supervisor under its own lock, the supervisor
+# reaches back under ITS lock. Wiring types the `sup` attribute; the
+# local constructor types `t`.
+CROSS_CLASS_INVERSION = '''
+import threading
+
+class Task:
+    def __init__(self):
+        self.state_lock = threading.Lock()
+        self.sup = None
+        self.v = 0
+
+    def die(self):
+        with self.state_lock:
+            self.sup.note_death(self){waiver_a}
+
+    def poke(self):
+        with self.state_lock:
+            self.v += 1
+
+class Supervisor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tasks = []
+
+    def note_death(self, t):
+        with self._lock:
+            self.tasks.append(t)
+
+    def cancel(self):
+        with self._lock:
+            t = Task()
+            t.poke()
+
+def wire():
+    t = Task()
+    t.sup = Supervisor()
+    return t
+'''
+
+
+def test_lockorder_cross_class_cycle_with_witness_path():
+    out = run_one(lockorder,
+                  [src("m.py", CROSS_CLASS_INVERSION.format(waiver_a=""))])
+    assert rules_of(out) == {"lockorder-cycle"}
+    assert len(out) == 2  # every edge of the ring is flagged
+    msgs = " | ".join(f.message for f in out)
+    # the full witness ring is printed, plus the per-edge call chain
+    assert "Task.state_lock -> Supervisor._lock" in msgs \
+        or "Supervisor._lock -> Task.state_lock" in msgs
+    assert "self.sup.note_death" in msgs
+    assert "t.poke" in msgs
+
+
+def test_lockorder_waiver_on_one_edge_suppresses_whole_cycle():
+    """A reviewed rationale on ANY edge breaks the ring — the sibling
+    edges must not keep nagging."""
+    code = CROSS_CLASS_INVERSION.format(
+        waiver_a="  # analyze: ok lockorder-cycle")
+    assert run_one(lockorder, [src("m.py", code)]) == []
+
+
+def test_lockorder_consistent_order_clean():
+    code = CROSS_CLASS_INVERSION.format(waiver_a="").replace(
+        "        with self._lock:\n            t = Task()\n"
+        "            t.poke()",
+        "        t = Task()\n        t.poke()")
+    assert run_one(lockorder, [src("m.py", code)]) == []
+
+
+def test_lockorder_condition_alias_collapses_onto_lock():
+    """Condition(self._lock) IS self._lock: acquiring the condition
+    then the lock of another class must not split one mutex into two
+    graph nodes (which would fabricate or hide cycles)."""
+    code = '''
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self.b = B()
+
+        def via_cv(self):
+            with self._cv:
+                self.b.touch()
+
+        def via_lock(self):
+            with self._lock:
+                self.b.touch()
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def touch(self):
+            with self._lock:
+                self.n += 1
+    '''
+    f = src("m.py", code)
+    edges = lockorder._collect_edges(
+        [f], lockorder.conc.build_program([f]))
+    assert set(edges) == {("A._lock", "B._lock")}  # ONE source node
+
+
+# ---- atomicity: check-then-act (ISSUE 14) ----------------------------------
+
+
+CHECK_THEN_ACT = '''
+import threading
+
+class Sup:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = {{}}
+
+    def add(self, q):
+        with self._lock:
+            self._pending[q] = 1
+
+    def drop(self, q):
+        with self._lock:
+            has = self._pending.get(q)
+        if has:{waiver}
+            with self._lock:
+                self._pending.pop(q)
+'''
+
+
+def test_atomicity_check_then_act_flagged():
+    out = run_one(atomicity,
+                  [src("m.py", CHECK_THEN_ACT.format(waiver=""))])
+    assert rules_of(out) == {"atomicity-check-act"}
+    (f,) = out
+    assert "drop" in f.message and "_pending" in f.message
+
+
+def test_atomicity_waiver_suppresses():
+    code = CHECK_THEN_ACT.format(waiver="  # analyze: ok atomicity-check-act")
+    assert run_one(atomicity, [src("m.py", code)]) == []
+
+
+def test_atomicity_recheck_idiom_clean():
+    """Re-acquire + re-check before acting is the check-twice idiom."""
+    code = CHECK_THEN_ACT.format(waiver="").replace(
+        "            with self._lock:\n                "
+        "self._pending.pop(q)",
+        "            with self._lock:\n                "
+        "if q in self._pending:\n                    "
+        "self._pending.pop(q)")
+    assert run_one(atomicity, [src("m.py", code)]) == []
+
+
+def test_atomicity_snapshot_return_clean():
+    """Reading under the lock and only RETURNING/reporting the value
+    is the snapshot idiom — no act, no finding."""
+    code = CHECK_THEN_ACT.format(waiver="").replace(
+        "        if has:\n            with self._lock:\n"
+        "                self._pending.pop(q)",
+        "        return has")
+    assert run_one(atomicity, [src("m.py", code)]) == []
+
+
+def test_atomicity_single_critical_section_clean():
+    """Check and act inside ONE with block: nothing outlives the
+    lock."""
+    code = '''
+    import threading
+
+    class Sup:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = {}
+
+        def add(self, q):
+            with self._lock:
+                self._pending[q] = 1
+
+        def drop(self, q):
+            with self._lock:
+                has = self._pending.get(q)
+                if has:
+                    self._pending.pop(q)
+    '''
+    assert run_one(atomicity, [src("m.py", code)]) == []
+
+
+# ---- waitholding (ISSUE 14) ------------------------------------------------
+
+
+JOIN_UNDER_LOCK = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run)
+        self._done = threading.Event()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        with self._lock:
+            self._thread.join(){waiver}
+'''
+
+
+def test_waitholding_join_under_lock_flagged():
+    out = run_one(waitholding,
+                  [src("m.py", JOIN_UNDER_LOCK.format(waiver=""))])
+    assert rules_of(out) == {"wait-holding"}
+    (f,) = out
+    assert "join()" in f.message and "Box._lock" in f.message
+
+
+def test_waitholding_waiver_suppresses():
+    code = JOIN_UNDER_LOCK.format(waiver="  # analyze: ok wait-holding")
+    assert run_one(waitholding, [src("m.py", code)]) == []
+
+
+def test_waitholding_join_outside_lock_clean():
+    code = JOIN_UNDER_LOCK.format(waiver="").replace(
+        "        with self._lock:\n            self._thread.join()",
+        "        self._thread.join()")
+    assert run_one(waitholding, [src("m.py", code)]) == []
+
+
+def test_waitholding_event_wait_and_queue_put_under_lock_flagged():
+    code = '''
+    import queue
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done = threading.Event()
+            self._q = queue.Queue(maxsize=4)
+
+        def bad_wait(self):
+            with self._lock:
+                self._done.wait()
+
+        def bad_put(self, item):
+            with self._lock:
+                self._q.put(item)
+
+        def ok_nowait(self, item):
+            with self._lock:
+                self._q.put_nowait(item)
+    '''
+    out = run_one(waitholding, [src("m.py", code)])
+    assert len(out) == 2
+    msgs = " | ".join(f.message for f in out)
+    assert "wait()" in msgs and "put()" in msgs
+    assert "ok_nowait" not in msgs
+
+
+def test_waitholding_condition_idiom_exempt():
+    """Waiting on the HELD condition releases it — never flagged,
+    including a Condition aliased onto the held lock."""
+    code = '''
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+
+        def wait_directly(self):
+            with self._cv:
+                self._cv.wait()
+
+        def wait_via_alias(self):
+            with self._lock:
+                self._cv.wait()
+    '''
+    assert run_one(waitholding, [src("m.py", code)]) == []
+
+
+def test_waitholding_appendfront_lane_shape_recognized_and_waived():
+    """Regression (ISSUE 14): the real append-front lane-lock put is
+    RECOGNIZED by the pass (lock families via locktrace.lock_list /
+    Lock() lists + blocking put under a family member) and suppressed
+    only by its reviewed waiver — if recognition regresses, the
+    waiver goes dead and this test fails."""
+    with open(os.path.join(REPO, "hstream_tpu", "server",
+                           "appendfront.py"), encoding="utf-8") as fh:
+        text = fh.read()
+    real = SourceFile("appendfront.py",
+                      "hstream_tpu/server/appendfront.py", text)
+    raw = waitholding.run([real], REPO)  # waivers NOT applied
+    assert any(f.rule == "wait-holding"
+               and "AppendFront.submit" in f.message for f in raw)
+    assert run_one(waitholding, [real]) == []  # waiver suppresses
 
 
 # ---- blocking --------------------------------------------------------------
@@ -1262,6 +1567,7 @@ def test_cli_json_output(tmp_path):
     assert len(records) == 1
     rec = records[0]
     assert rec["rule"] == "lock-guard"
+    assert rec["pass"] == "locks"  # owning pass per record (ISSUE 14)
     assert rec["path"] == "hstream_tpu/box.py"
     assert isinstance(rec["line"], int) and rec["line"] > 0
     assert "_val" in rec["message"]
@@ -1272,6 +1578,61 @@ def test_cli_json_output(tmp_path):
          "--repo", str(mini), "--baseline", base, "--json"],
         capture_output=True, text=True, cwd=REPO)
     assert r.returncode == 0 and json.loads(r.stdout) == []
+
+
+def test_cli_json_stable_order_and_pass_names(tmp_path):
+    """--json output is a total order over (path, line, rule, message)
+    and every record names its owning pass — CI annotators must not
+    have to re-sort or re-derive the rule->pass mapping (ISSUE 14)."""
+    from tools.analyze import all_passes, rule_passes
+
+    owners = rule_passes()
+    for name, mod in all_passes().items():
+        for rid in mod.RULES:
+            assert owners[rid] == name
+    mini = tmp_path / "mini"
+    (mini / "hstream_tpu").mkdir(parents=True)
+    (mini / "tools").mkdir()
+    (mini / "bench.py").write_text("")
+    # two findings from two passes in one file: locks + waitholding
+    (mini / "hstream_tpu" / "box.py").write_text(textwrap.dedent('''
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._val = 0
+            self._thread = threading.Thread(target=self.bump)
+
+        def bump(self):
+            with self._lock:
+                self._val += 1
+
+        def reset(self):
+            with self._lock:
+                self._val = 0
+
+        def peek(self):
+            return self._val
+
+        def stop(self):
+            with self._lock:
+                self._thread.join()
+    '''))
+    base = str(tmp_path / "b.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--repo", str(mini),
+         "--baseline", base, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    records = json.loads(r.stdout)
+    assert len(records) >= 2
+    keys = [(x["path"], x["line"], x["rule"], x["message"])
+            for x in records]
+    assert keys == sorted(keys)
+    by_rule = {x["rule"]: x["pass"] for x in records}
+    assert by_rule.get("lock-guard") == "locks"
+    assert by_rule.get("wait-holding") == "waitholding"
 
 
 # ---- RetraceGuard: runtime recompile contract (ISSUE 7) --------------------

@@ -1185,3 +1185,223 @@ def test_registry_delay_schedule_sleeps_only_scheduled_hit():
     reg.point("x")  # hit 2: ~40ms
     assert time.perf_counter() - t0 >= 0.035
     assert reg.status()["x"]["injected"] == 1
+
+
+# ---- seeded interleaving perturbation (ISSUE 14) ---------------------------
+#
+# The lock-order witness armed + yield: schedules at the traced
+# lock.acquire.* sites: each scenario replays a documented race family
+# under K seeds, asserting (a) the witness reports ZERO lock-order
+# cycles, and (b) the subsystem's exact-result contract holds under
+# every explored interleaving. A failing seed replays identically.
+
+from hstream_tpu.common.locktrace import LOCKTRACE
+
+INTERLEAVE_SEEDS = (3, 17, 101)
+
+
+def _arm_yields(sites, seed, n=2):
+    for site in sites:
+        FAULTS.arm(f"lock.acquire.{site}", f"yield:{n}:{seed}")
+
+
+def test_interleaving_appendfront_submit_vs_close_races():
+    """Submitters racing close() across lanes: every submitted future
+    settles (an accepted batch lands durably IN ORDER, a refused
+    submit raises the closed error), nothing hangs, and the armed
+    witness sees no lock-order cycle — under every seed."""
+    from hstream_tpu.server.appendfront import AppendFront
+    from hstream_tpu.store.memstore import MemLogStore
+
+    for seed in INTERLEAVE_SEEDS:
+        FAULTS.disarm()
+        LOCKTRACE.disarm()
+        LOCKTRACE.arm()
+        _arm_yields(("appendfront.lane", "appendfront.submit",
+                     "appendfront.stat"), seed)
+        store = MemLogStore()
+        for logid in (1, 2, 3, 4):
+            store.create_log(logid)
+        front = AppendFront(store, lanes=2)
+        results: dict[int, list] = {t: [] for t in range(4)}
+
+        def producer(tid):
+            for i in range(25):
+                payload = b"%d:%d" % (tid, i)
+                try:
+                    fut = front.submit(1 + tid, [payload])
+                except RuntimeError:
+                    results[tid].append(("refused", payload))
+                    continue
+                try:
+                    lsn = fut.result(timeout=10)
+                    results[tid].append(("ok", payload, lsn))
+                except Exception:  # noqa: BLE001 — racing close()
+                    results[tid].append(("failed", payload))
+
+        threads = [__import__("threading").Thread(
+            target=producer, args=(t,)) for t in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        front.close(timeout=10)
+        for t in threads:
+            t.join(timeout=15)
+            assert not t.is_alive(), f"seed {seed}: producer hung"
+        st = front.stats()
+        assert st["in_flight"] == 0, \
+            f"seed {seed}: unresolved futures ({st})"
+        for tid in range(4):
+            accepted = [r for r in results[tid] if r[0] == "ok"]
+            # every future settled one way or the other
+            assert len(results[tid]) == 25
+            assert not any(r[0] == "failed" for r in results[tid]), \
+                f"seed {seed}: a submitted future errored ({results[tid]})"
+            # durable, exactly the accepted payloads, in submit order
+            landed = [p for _lsn, ps in _log_contents(store, 1 + tid)
+                      for p in ps]
+            assert landed == [r[1] for r in accepted], \
+                f"seed {seed}: lane {tid} order/contents diverged"
+        assert LOCKTRACE.cycles() == [], \
+            f"seed {seed}: witness reported a lock-order cycle"
+        LOCKTRACE.disarm()
+        FAULTS.disarm()
+
+
+def test_interleaving_supervisor_restart_vs_cancel_races():
+    """note_death racing cancel(): after cancel() returns there is no
+    pending or in-flight restart left for the query and no resurrect
+    can land later — under every seed, witness armed, yields at the
+    supervisor lock."""
+    import threading as _threading
+
+    from hstream_tpu.server.persistence import QueryInfo
+    from hstream_tpu.server.scheduler import QuerySupervisor
+
+    class _Persist:
+        def get_query(self, qid):
+            return QueryInfo(qid, "select 1", 0)
+
+        def set_query_status(self, qid, status):
+            pass
+
+    class _Ctx:
+        def __init__(self):
+            self.running_queries = {}
+            self.persistence = _Persist()
+
+    for seed in INTERLEAVE_SEEDS:
+        FAULTS.disarm()
+        LOCKTRACE.disarm()
+        LOCKTRACE.arm()
+        _arm_yields(("scheduler.supervisor",), seed)
+        ctx = _Ctx()
+        resumed = []
+        sup = QuerySupervisor(ctx, resume_fn=resumed.append, seed=seed)
+        sup.BACKOFF_BASE_S = 0.001
+        info = QueryInfo("q-race", "select 1", 0)
+        try:
+            for round_ in range(8):
+                sup.note_death(info, RuntimeError(f"death {round_}"))
+                canceller = _threading.Thread(
+                    target=sup.cancel, args=("q-race",))
+                canceller.start()
+                canceller.join(timeout=35)
+                assert not canceller.is_alive(), \
+                    f"seed {seed}: cancel() hung"
+                st = sup.status()
+                assert "q-race" not in st["pending"], \
+                    f"seed {seed}: pending restart survived cancel"
+                n_after_cancel = len(resumed)
+                time.sleep(0.02)
+                assert len(resumed) == n_after_cancel, \
+                    f"seed {seed}: a restart resurrected after cancel"
+        finally:
+            sup.shutdown()
+        assert LOCKTRACE.cycles() == [], \
+            f"seed {seed}: witness reported a lock-order cycle"
+        LOCKTRACE.disarm()
+        FAULTS.disarm()
+
+
+def test_interleaving_promotion_vs_append_races():
+    """A producer appending through the leader store while a follower
+    is promoted out from under it: every append either lands durably
+    on the promoted side exactly once or raises the typed NotLeader
+    refusal — never both, never lost after ack — and the armed
+    witness sees no replica lock-order cycle."""
+    from hstream_tpu.common.errors import NotLeaderError
+
+    for seed in INTERLEAVE_SEEDS[:2]:  # two seeds keep CI < 30s
+        FAULTS.disarm()
+        LOCKTRACE.disarm()
+        LOCKTRACE.arm()
+        _arm_yields(("replica.oplog", "replica.follower"), seed)
+        follower_store = open_store("mem://")
+        port = _free_port()
+        fsrv, svc = serve_follower(follower_store, f"127.0.0.1:{port}",
+                                   node_id="replica-p")
+        leader = ReplicatedStore(open_store("mem://"),
+                                 [f"127.0.0.1:{port}"],
+                                 replication_factor=2,
+                                 ack_timeout_s=2.0)
+        try:
+            leader.create_log(7)
+            acked: list[tuple[int, bytes, str]] = []
+            refused = []
+
+            def producer():
+                for i in range(40):
+                    payload = b"row-%d" % i
+                    try:
+                        lsn = leader.append_batch(7, [payload])
+                        # single appender: last_ack_status is ours.
+                        # An append racing the fence acks DEGRADED
+                        # (journaled, observable) — only a fully
+                        # "replicated" ack promises follower
+                        # durability (the ISSUE 9 contract)
+                        acked.append((lsn, payload,
+                                      leader.last_ack_status))
+                    except NotLeaderError:
+                        refused.append(payload)
+                        return
+                    except Exception:  # noqa: BLE001 — a replicate
+                        # racing the fence can surface as a transport
+                        # error; the contract below only binds ACKED
+                        refused.append(payload)
+                        return
+
+            t = __import__("threading").Thread(target=producer)
+            t.start()
+            time.sleep(0.02)
+            # promotion out from under the producer
+            promo = promote_best([f"127.0.0.1:{port}"],
+                                 leader_addr="127.0.0.1:1")
+            assert promo["ok"], promo
+            t.join(timeout=30)
+            assert not t.is_alive(), f"seed {seed}: producer hung"
+            # every ACKED append is durable on the promoted follower
+            _wait(lambda: svc.applied_seq >= leader.oplog_seq
+                  or leader.fenced_by is not None, timeout=10)
+            landed = dict(_log_contents(follower_store, 7))
+            replicated = [(lsn, p) for lsn, p, st in acked
+                          if st == "replicated"]
+            assert replicated, f"seed {seed}: nothing replicated " \
+                               f"before the fence — scenario degenerate"
+            for lsn, payload in replicated:
+                assert landed.get(lsn) == (payload,), \
+                    f"seed {seed}: acked lsn {lsn} missing/diverged"
+            # the fence window is honest: anything acked after the
+            # promotion was marked degraded, never silently clean
+            if leader.fenced_by is not None and len(replicated) < \
+                    len(acked):
+                assert any(st != "replicated"
+                           for _l, _p, st in acked)
+        finally:
+            leader.close()
+            svc.close()
+            fsrv.stop(grace=1)
+        assert LOCKTRACE.cycles() == [], \
+            f"seed {seed}: witness reported a lock-order cycle"
+        LOCKTRACE.disarm()
+        FAULTS.disarm()

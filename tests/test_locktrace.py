@@ -1,0 +1,297 @@
+"""Runtime lock-order witness unit tests (ISSUE 14).
+
+GoodLock semantics on the TracedLock wrapper: cycle detection fires on
+an order inversion WITHOUT needing the unlucky schedule, re-entrant
+RLocks and same-name lock families never false-positive, a Condition
+over a traced lock keeps the held-set truthful across waits, the
+disarmed wrapper records nothing, and the seeded `yield:` perturber
+replays deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from hstream_tpu.common import locktrace
+from hstream_tpu.common.faultinject import FAULTS
+from hstream_tpu.common.locktrace import LOCKTRACE, TracedLock
+from hstream_tpu.stats import StatsHolder
+from hstream_tpu.stats.events import EventJournal
+
+
+@pytest.fixture(autouse=True)
+def _fresh_witness():
+    """LOCKTRACE is process-global: every test starts and ends
+    disarmed with no residual graph (and no armed fault sites)."""
+    LOCKTRACE.disarm()
+    FAULTS.disarm()
+    yield
+    LOCKTRACE.disarm()
+    FAULTS.disarm()
+    LOCKTRACE.bind(stats=None, events=None)
+
+
+def test_cycle_detection_fires_on_inversion_without_deadlock():
+    """A -> B in one section, B -> A in a later one: the second edge
+    direction closes the ring and reports a POTENTIAL deadlock even
+    though this single thread never deadlocks (the GoodLock point)."""
+    events = EventJournal()
+    LOCKTRACE.bind(events=events)
+    LOCKTRACE.arm()
+    a = locktrace.lock("t.a")
+    b = locktrace.lock("t.b")
+    with a:
+        with b:
+            pass
+    assert LOCKTRACE.cycles() == []
+    with b:
+        with a:
+            pass
+    cycles = LOCKTRACE.cycles()
+    assert len(cycles) == 1
+    ring = cycles[0]["ring"]
+    assert sorted(tuple(e) for e in ring) == [("t.a", "t.b"),
+                                              ("t.b", "t.a")]
+    # the witness names the thread and the full held stack per edge
+    wit = cycles[0]["witness"]
+    assert set(wit) == {"t.a->t.b", "t.b->t.a"}
+    assert all("thread" in w and "holding" in w for w in wit.values())
+    # journaled exactly once as a lock_cycle event
+    kinds = [e["kind"] for e in events.query(limit=100)]
+    assert kinds.count("lock_cycle") == 1
+    # the SAME inversion again does not re-report (edge already known)
+    with b:
+        with a:
+            pass
+    assert len(LOCKTRACE.cycles()) == 1
+
+
+def test_reentrant_rlock_no_false_positive():
+    """Re-entering one RLock instance adds no edge (no self-cycle),
+    and depth counting pairs releases correctly."""
+    LOCKTRACE.arm()
+    r = locktrace.rlock("t.r")
+    other = locktrace.lock("t.o")
+    with r:
+        with r:           # re-entrant: depth only
+            with other:
+                pass
+    assert LOCKTRACE.cycles() == []
+    st = LOCKTRACE.status()
+    assert st["edges"] == {"t.r": ["t.o"]}
+    # fully released: a fresh thread can take (and release) it
+    grabbed = []
+
+    def grab():
+        if r.acquire(timeout=1):
+            grabbed.append(True)
+            r.release()
+
+    t = threading.Thread(target=grab)
+    t.start()
+    t.join()
+    assert grabbed == [True]
+
+
+def test_same_name_family_nesting_adds_no_edge():
+    """Two instances of one lock ROLE nested (append-front lanes) add
+    no self-edge — instance identity is not class identity."""
+    LOCKTRACE.arm()
+    lanes = locktrace.lock_list("t.lane", 2)
+    with lanes[0]:
+        with lanes[1]:
+            pass
+    assert LOCKTRACE.edge_count() == 0
+    assert LOCKTRACE.cycles() == []
+
+
+def test_disarmed_wrapper_records_nothing():
+    """Disarmed contract: nested acquires leave NO graph, NO counts,
+    NO cycles — the one-attribute-read + one-branch path."""
+    a = locktrace.lock("t.da")
+    b = locktrace.lock("t.db")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert LOCKTRACE.edge_count() == 0
+    st = LOCKTRACE.status()
+    assert st["locks"] == {} and st["cycles"] == []
+    assert not st["armed"]
+
+
+def test_wait_hold_histograms_and_contention_counter():
+    """Bound StatsHolder: a contended acquire counts lock_contention
+    and lands in lock_wait_ms; every release lands in lock_hold_ms."""
+    stats = StatsHolder()
+    LOCKTRACE.bind(stats=stats)
+    LOCKTRACE.arm()
+    lk = locktrace.lock("t.cont")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(5)
+    got = []
+
+    def contender():
+        with lk:
+            got.append(True)
+
+    t2 = threading.Thread(target=contender)
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    t.join(5)
+    t2.join(5)
+    assert got == [True]
+    assert stats.stream_stat_get("lock_contention", "t.cont") == 1
+    hists = stats.histograms_snapshot()
+    assert ("lock_wait_ms", "t.cont") in hists
+    hold = hists[("lock_hold_ms", "t.cont")]
+    assert hold.count == 2  # holder + contender both released
+    # the ledger surfaces percentiles when stats are bound
+    row = LOCKTRACE.status()["locks"]["t.cont"]
+    assert row["acquires"] == 2 and row["contentions"] == 1
+    assert row["wait_p50_ms"] is not None
+    assert row["hold_p50_ms"] is not None
+
+
+def test_condition_over_traced_lock_releases_during_wait():
+    """threading.Condition(TracedLock): wait() really releases the
+    wrapper (another thread acquires it mid-wait), the held-set drops
+    the entry, and notify wakes the waiter — semantics preserved."""
+    LOCKTRACE.arm()
+    lk = locktrace.lock("t.cv")
+    cv = threading.Condition(lk)
+    state = {"woke": False}
+    waiting = threading.Event()
+
+    def waiter():
+        with cv:
+            waiting.set()
+            cv.wait(timeout=5)
+            state["woke"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert waiting.wait(5)
+    # the waiter is inside wait(): the lock must be takeable NOW
+    assert lk.acquire(timeout=2)
+    lk.release()
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    assert state["woke"]
+    assert LOCKTRACE.cycles() == []
+
+
+def test_condition_over_traced_rlock_wait_notify():
+    """The re-entrant wrapper forwards the Condition protocol
+    (_release_save/_acquire_restore/_is_owned) to the inner RLock."""
+    LOCKTRACE.arm()
+    cv = threading.Condition(locktrace.rlock("t.rcv"))
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(5)
+    assert woke.is_set()
+
+
+def test_rearm_after_disarm_starts_fresh():
+    LOCKTRACE.arm()
+    a = locktrace.lock("t.fa")
+    b = locktrace.lock("t.fb")
+    with a:
+        with b:
+            pass
+    assert LOCKTRACE.edge_count() == 1
+    LOCKTRACE.disarm()
+    LOCKTRACE.arm()
+    assert LOCKTRACE.edge_count() == 0
+    with b:
+        with a:
+            pass
+    # the PRIOR direction was forgotten with the disarm: no cycle
+    assert LOCKTRACE.cycles() == []
+
+
+def test_disarm_straddling_acquire_leaves_no_stale_holder():
+    """Review fix (ISSUE 14): a thread that passes the wrapper's armed
+    gate just before a disarm must not leave a stale held-set entry —
+    its release runs disarmed and would never pair up, and every lock
+    the thread takes after a re-arm would appear falsely nested under
+    the ghost holder. note_acquire re-checks `active`, and the
+    generation bump discards any stack that straddled the boundary."""
+    LOCKTRACE.arm()
+    a = locktrace.lock("t.sa")
+    b = locktrace.lock("t.sb")
+    a.acquire()           # held entry recorded while armed
+    LOCKTRACE.disarm()    # gen bump: the recorded stack is stale
+    a.release()           # disarmed release: note_release skipped
+    LOCKTRACE.arm()
+    # the ghost holder must be gone: taking b then a in the "wrong"
+    # order relative to the ghost must create NO edge from t.sa
+    with b:
+        pass
+    st = LOCKTRACE.status()
+    assert st["edges"] == {} and st["cycles"] == []
+    # and the direct shape: note_acquire entered while disarmed
+    # records nothing even if the gate was passed before the flip
+    LOCKTRACE.disarm()
+    LOCKTRACE.note_acquire(a, 0.0, contended=False)
+    LOCKTRACE.arm()
+    with b:
+        pass
+    st = LOCKTRACE.status()
+    assert st["edges"] == {} and st["cycles"] == []
+
+
+def test_yield_perturber_is_seeded_and_deterministic():
+    """yield:N[:SEED] injects the same decision stream per seed; every
+    traced acquire is a lock.acquire.<name> fault site."""
+    lk = locktrace.lock("t.y")
+
+    def run(seed):
+        FAULTS.disarm()
+        FAULTS.arm(lk.site, f"yield:3:{seed}")
+        for _ in range(60):
+            with lk:
+                pass
+        st = FAULTS.status()[lk.site]
+        return st["hits"], st["injected"]
+
+    h1, i1 = run(7)
+    h2, i2 = run(7)
+    h3, i3 = run(11)
+    assert (h1, i1) == (h2, i2) == (60, i1)
+    assert i1 > 0  # ~1/3 of 60 hits yield; a zero means the schedule
+    #                never fired and the perturber is dead
+    assert h3 == 60  # different seed: same hit count, its own stream
+
+
+def test_yield_rejects_bad_n():
+    with pytest.raises(ValueError):
+        FAULTS.arm("x", "yield:0")
+    with pytest.raises(ValueError):
+        FAULTS.arm("x", "yield")

@@ -172,6 +172,10 @@ def _golden_holder() -> StatsHolder:
     stats.observe("append_visible_latency_ms", "q1", 45.0)
     stats.observe("emit_latency_ms", "q1", 12.0)
     stats.observe("kernel_dispatch_ms", "step", 1.5)
+    # lock-order witness ledger (ISSUE 14): wait/hold + contention
+    stats.stream_stat_add("lock_contention", "tasks.state", 3)
+    stats.observe("lock_wait_ms", "tasks.state", 0.8)
+    stats.observe("lock_hold_ms", "tasks.state", 2.0)
     return stats
 
 
@@ -839,6 +843,22 @@ def test_query_label_counters_survive_stream_filter():
     text = render_holder(stats, live_streams=set(), live_queries=set())
     assert "q9" not in text
     assert 'hstream_factory_recompiles_total{stream="probe"} 1' in text
+
+
+def test_lock_label_counters_survive_stream_filter():
+    """lock_contention is labeled by a traced-lock ROLE name — never a
+    stream, so the liveness filter must not drop it; the wait/hold
+    histograms carry the `lock` label key (ISSUE 14)."""
+    stats = StatsHolder()
+    stats.stream_stat_add("lock_contention", "tasks.state", 5)
+    stats.observe("lock_wait_ms", "tasks.state", 1.2)
+    stats.observe("lock_hold_ms", "scheduler.supervisor", 0.3)
+    text = render_holder(stats, live_streams=set(), live_queries=set())
+    assert 'hstream_lock_contention_total{stream="tasks.state"} 5' \
+        in text
+    assert 'hstream_lock_wait_ms_count{lock="tasks.state"} 1' in text
+    assert 'hstream_lock_hold_ms_count{lock="scheduler.supervisor"} 1' \
+        in text
 
 
 # ---- /overview wiring (satellite) ------------------------------------------

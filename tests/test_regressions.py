@@ -1095,3 +1095,94 @@ def test_gateway_rebind_retires_old_channel_instead_of_closing():
     assert gw.leader_follows == 1
     gw.close()
     assert gw._retired == []
+
+
+# ---- ISSUE 14: concurrency certification ------------------------------------
+#
+# The three new passes (lockorder/atomicity/waitholding) came up CLEAN
+# on the tree — the expected candidates (supervisor corpse/cancel,
+# gateway rebind, append-front close) had been fixed by hand in the
+# PR 8/11 review rounds, and the passes now pin those shapes via
+# fixtures in test_analyze. What this section pins is the live-tree
+# contracts behind that verdict: the canonical lock ORDER the static
+# graph documents, the one reviewed waiver, and the witness's
+# disarmed-cost contract on the real instrumented subsystems.
+
+
+def test_lockorder_real_tree_graph_acyclic_with_canonical_edges():
+    """The whole-program lock graph of THIS tree resolves the
+    documented cross-object orders (tasks.state before
+    views.materialization via Materialization.snapshot; the scrape
+    lock before the gauge internals) and stays acyclic. If the
+    cross-class typing regresses these edges vanish; if someone
+    introduces an inversion the cycle list goes non-empty — both fail
+    here before CI's analyze step even runs."""
+    import os
+    import sys
+
+    REPO_ROOT = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    sys.path.insert(0, REPO_ROOT)
+    from tools.analyze import load_tree
+    from tools.analyze.passes import conc, lockorder
+
+    files = load_tree(REPO_ROOT)
+    prog = conc.build_program(files)
+    edges = lockorder._collect_edges(files, prog)
+    got = set(edges)
+    assert ("QueryTask.state_lock", "Materialization._lock") in got
+    assert ("StatsHolder.scrape_lock", "StatsHolder._gauge_lock") in got
+    assert lockorder._cycles(edges) == []
+
+
+def test_witness_certifies_task_before_materialization_order():
+    """The live (armed) witness observes the canonical order on the
+    REAL objects: sink-under-state_lock then snapshot both take
+    tasks.state before views.materialization — one direction, no
+    cycle, and the ledger carries both lock roles."""
+    from hstream_tpu.common import locktrace
+    from hstream_tpu.common.locktrace import LOCKTRACE
+
+    LOCKTRACE.disarm()
+    LOCKTRACE.arm()
+    try:
+        mat = Materialization(group_cols=["k"])
+
+        class _Task:
+            state_lock = locktrace.rlock("tasks.state")
+            executor = None
+
+        task = _Task()
+        mat.task = task
+        # the sink path: task emits closed rows under its state lock
+        with task.state_lock:
+            mat.add_closed([{"k": "a", "winStart": 1}])
+        # the pull path: snapshot takes state_lock then mat._lock
+        assert mat.snapshot() == [{"k": "a", "winStart": 1}]
+        st = LOCKTRACE.status()
+        assert st["edges"].get("tasks.state") == \
+            ["views.materialization"]
+        assert "views.materialization" not in st["edges"]
+        assert st["cycles"] == []
+        assert {"tasks.state", "views.materialization"} <= \
+            set(st["locks"])
+    finally:
+        LOCKTRACE.disarm()
+
+
+def test_witness_disarmed_records_nothing_on_real_subsystems():
+    """Disarmed-cost contract on the real instrumented objects: a
+    subscription-registry + materialization + supervisor workout with
+    the witness disarmed leaves ZERO witness state."""
+    from hstream_tpu.common.locktrace import LOCKTRACE
+    from hstream_tpu.server.subscriptions import SubscriptionRegistry
+
+    LOCKTRACE.disarm()
+    reg = SubscriptionRegistry()
+    assert reg.exists("nope") is False
+    mat = Materialization(group_cols=["k"])
+    mat.add_closed([{"k": "a", "winStart": 1}])
+    assert mat.dump() == [{"k": "a", "winStart": 1}]
+    st = LOCKTRACE.status()
+    assert st["locks"] == {} and st["edges"] == {} \
+        and st["cycles"] == []

@@ -9,6 +9,16 @@ Engler et al.'s "bugs as deviant behavior" (rules inferred from the
 tree's own majority idiom, violations flagged in the minority):
 
   locks        lock-guard / lock-order   guarded-attribute discipline
+  lockorder    lockorder-cycle           whole-program lock-acquisition
+                                         graph (with-nesting + cross-
+                                         class call edges) is acyclic
+  atomicity    atomicity-check-act       a guarded read's decision may
+                                         not outlive its critical
+                                         section when the branch acts
+                                         on the same lock's state
+  waitholding  wait-holding              no join/result/wait/queue
+                                         blocking while holding an
+                                         unrelated lock
   blocking     blocking-hot              no unbounded blocking in gRPC
                                          handlers, the Prometheus scrape
                                          path, or worker loops
@@ -143,21 +153,33 @@ def load_tree(repo: str = REPO) -> list[SourceFile]:
 def all_passes() -> dict[str, object]:
     """name -> pass module, in canonical order."""
     from tools.analyze.passes import (
+        atomicity,
         blocking,
         dispatch,
         errcontract,
         lifecycle,
+        lockorder,
         locks,
         overflow,
         purity,
         registry,
         retrace,
         shardmap,
+        waitholding,
     )
 
     return {m.NAME: m for m in
-            (locks, blocking, purity, dispatch, retrace, overflow,
-             shardmap, errcontract, lifecycle, registry)}
+            (locks, lockorder, atomicity, waitholding, blocking,
+             purity, dispatch, retrace, overflow, shardmap,
+             errcontract, lifecycle, registry)}
+
+
+def rule_passes() -> dict[str, str]:
+    """rule id -> owning pass name (the --json `pass` field: CI
+    annotators group/route findings by pass without re-deriving the
+    mapping)."""
+    return {rid: name for name, mod in all_passes().items()
+            for rid in mod.RULES}
 
 
 def load_baseline(path: str = BASELINE_PATH) -> set[tuple[str, str, str]]:
@@ -218,9 +240,9 @@ def main(argv: list[str] | None = None) -> int:
         description="repo-native static analysis (see tools/analyze)")
     ap.add_argument("--only", default=None,
                     help="comma-separated pass names "
-                         "(locks,blocking,purity,dispatch,retrace,"
-                         "overflow,shardmap,errcontract,lifecycle,"
-                         "registry)")
+                         "(locks,lockorder,atomicity,waitholding,"
+                         "blocking,purity,dispatch,retrace,overflow,"
+                         "shardmap,errcontract,lifecycle,registry)")
     ap.add_argument("--stats", action="store_true",
                     help="emit per-rule finding counts (incl. baselined)")
     ap.add_argument("--json", action="store_true",
@@ -270,10 +292,17 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.json:
         # machine output only: one array of finding records, so CI
-        # annotators never have to scrape the human report
-        print(json.dumps([{"rule": f.rule, "path": f.path,
+        # annotators never have to scrape the human report. Each
+        # record carries its owning pass, and the array order is a
+        # total order over the record fields — deterministic for CI
+        # annotation diffing, so consumers stop re-sorting (ISSUE 14)
+        owners = rule_passes()
+        ordered = sorted(new, key=lambda f: (f.path, f.line, f.rule,
+                                             f.message))
+        print(json.dumps([{"pass": owners.get(f.rule, "?"),
+                           "rule": f.rule, "path": f.path,
                            "line": f.line, "message": f.message}
-                          for f in new]))
+                          for f in ordered]))
         return 1 if new else 0
 
     if args.stats:

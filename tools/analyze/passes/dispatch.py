@@ -84,6 +84,10 @@ HOT_PATH_FILES = (
     # sync creeping into the ingest door is a regression
     "hstream_tpu/common/colframe.py",
     "hstream_tpu/server/appendfront.py",
+    # the traced-lock wrapper (ISSUE 14) sits inside every
+    # instrumented drain path: a device sync creeping into acquire/
+    # release would tax every critical section in the server
+    "hstream_tpu/common/locktrace.py",
 )
 
 # factories whose RESULT is a compiled kernel callable

@@ -68,8 +68,13 @@ def _lock_attrs(cls: ast.ClassDef) -> set[str]:
             v = node.value
             if isinstance(v, ast.Call):
                 cn = dotted(v.func) or ""
-                if cn.split(".")[-1] in ("Lock", "RLock", "Condition",
-                                         "Semaphore", "BoundedSemaphore"):
+                leaf = cn.split(".")[-1]
+                # locktrace factories (ISSUE 14): named traced locks
+                # are locks for every inference purpose
+                if leaf in ("Lock", "RLock", "Condition", "Semaphore",
+                            "BoundedSemaphore", "TracedLock") or \
+                        cn in ("locktrace.lock", "locktrace.rlock",
+                               "locktrace.lock_list"):
                     for tgt in node.targets:
                         d = dotted(tgt)
                         if d and d.startswith("self."):
@@ -142,10 +147,14 @@ class _MethodScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _scan_class(src, cls: ast.ClassDef) -> list[Finding]:
+def infer_guards(cls: ast.ClassDef
+                 ) -> tuple[set[str], dict[str, set[str]], dict]:
+    """(lock attrs, attr -> guarding locks, method scans) for one
+    class — the per-class ownership inference, shared with the
+    atomicity pass (ISSUE 14)."""
     lock_attrs = _lock_attrs(cls)
     if not lock_attrs:
-        return []
+        return set(), {}, {}
     methods = list(class_methods(cls))
     scans = {m.name: (_MethodScan(lock_attrs, m), m) for m in methods}
 
@@ -164,9 +173,16 @@ def _scan_class(src, cls: ast.ClassDef) -> list[Finding]:
                 if is_write:
                     written_under.setdefault(attr, set()).add(lock)
     guards: dict[str, set[str]] = {}  # attr -> inferred guarding locks
-    for (attr, lock), methods in locked_in.items():
-        if len(methods) >= 2 and lock in written_under.get(attr, ()):
+    for (attr, lock), ms in locked_in.items():
+        if len(ms) >= 2 and lock in written_under.get(attr, ()):
             guards.setdefault(attr, set()).add(lock)
+    return lock_attrs, guards, scans
+
+
+def _scan_class(src, cls: ast.ClassDef) -> list[Finding]:
+    lock_attrs, guards, scans = infer_guards(cls)
+    if not lock_attrs:
+        return []
 
     out: list[Finding] = []
     for name, (scan, fn) in scans.items():
