@@ -1303,8 +1303,14 @@ def _smoke_server_columnar(batches: int = 50) -> int:
 
     # tracing ARMED at sample rate 1 (ISSUE 13 acceptance): every RPC
     # and task stage records spans, and the steady state must still
-    # compile nothing — the span plane is host-only by construction
-    server, ctx = serve("127.0.0.1", 0, "mem://", trace_sample=1.0)
+    # compile nothing — the span plane is host-only by construction.
+    # The stats plane is likewise armed hot (ISSUE 15): the load
+    # reporter folds the holder every 500ms DURING the guarded run,
+    # and the guarded region itself scrapes the stats/cluster-stats
+    # verbs — rate ladders, federation fold, and exposition are
+    # host-only by construction too
+    server, ctx = serve("127.0.0.1", 0, "mem://", trace_sample=1.0,
+                        load_report_interval_ms=500)
     ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
     stub = HStreamApiStub(ch)
     try:
@@ -1372,6 +1378,16 @@ def _smoke_server_columnar(batches: int = 50) -> int:
         stream_batches(3, warm)  # burst: spans window closes
         with RetraceGuard() as g:
             stream_batches(warm, warm + batches)
+            # stats plane armed mid-steady-state: one scrape + one
+            # federation fold must compile nothing
+            from hstream_tpu.common import records as _rec
+            from hstream_tpu.stats.prometheus import render_metrics
+
+            render_metrics(ctx)
+            stub.SendAdminCommand(pb.AdminCommandRequest(
+                command="stats",
+                args=_rec.dict_to_struct({"entity": "streams"})))
+            stub.ClusterStats(pb.ClusterStatsRequest())
         return g.count
     finally:
         ch.close()

@@ -65,14 +65,42 @@ def cmd_subscriptions(stub, args) -> list[dict]:
 
 
 def cmd_stats(stub, args) -> list[dict]:
-    out = stub.GetStats(pb.GetStatsRequest()).stats
-    rows = []
-    for s in out:
-        row = {"stream": s.stream_name}
-        row.update({k: s.counters[k] for k in sorted(s.counters)})
-        row.update({k: round(s.rates[k], 2) for k in sorted(s.rates)})
-        rows.append(row)
-    return rows
+    """Declarative-family rate tables (the `hadmin server stats`
+    analogue): one row per entity with every family's rate at the
+    requested ladder interval (1min/10min/1h) + all-time totals;
+    --json prints the raw verb output for scripting."""
+    out = _admin(stub, "stats", entity=args.entity,
+                 interval=args.interval)
+    if getattr(args, "json", False):
+        import json
+
+        print(json.dumps({r.pop("key"): r for r in out}, indent=2,
+                         sort_keys=True))
+        return []
+    label = {"streams": "stream", "subscriptions": "subscription",
+             "queries": "query"}.get(args.entity, "key")
+    return [{label: r.pop("key"), **r} for r in out]
+
+
+def cmd_cluster_stats(stub, args) -> list[dict]:
+    """Federated node load reports (ISSUE 15): fan the ClusterStats
+    RPC out to --peers (or the leader's followers) and print ONE
+    merged per-node table — a node summary row per node, then one row
+    per (node, stream) with the family rate ladder."""
+    from hstream_tpu.stats.cluster import merge_rows
+
+    kwargs = {"interval": args.interval, "timeout_s": args.timeout}
+    if args.peers:
+        kwargs["peers"] = args.peers
+    out = _admin(stub, "cluster-stats", **kwargs)
+    reports = {r.pop("key"): r for r in out}
+    if getattr(args, "json", False):
+        import json
+
+        print(json.dumps(reports, indent=2, sort_keys=True))
+        return []
+    return merge_rows([reports[k] for k in sorted(reports)],
+                      interval=args.interval)
 
 
 def cmd_trace(stub, args) -> list[dict]:
@@ -350,8 +378,31 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=6570)
     sub = ap.add_subparsers(dest="cmd", required=True)
     for name in ("status", "streams", "queries", "views", "connectors",
-                 "subscriptions", "stats"):
+                 "subscriptions"):
         sub.add_parser(name)
+    p = sub.add_parser("stats",
+                       help="per-entity rate-family tables off the "
+                            "multi-level ladders (1min/10min/1h)")
+    p.add_argument("entity", nargs="?", default="streams",
+                   choices=["streams", "subscriptions", "queries"])
+    p.add_argument("--interval", default="1min",
+                   choices=["1min", "10min", "1h"],
+                   help="trailing ladder window the rates cover")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the table")
+    p = sub.add_parser("cluster-stats",
+                       help="federated node load reports: one merged "
+                            "per-node table (rates, health, rss, "
+                            "queue depths) across --peers/followers")
+    p.add_argument("--peers", default=None, metavar="ADDR,ADDR",
+                   help="peer server addresses to fan out to "
+                        "(default: this leader's store followers)")
+    p.add_argument("--interval", default="1min",
+                   choices=["1min", "10min", "1h"])
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-peer fan-out timeout (seconds)")
+    p.add_argument("--json", action="store_true",
+                   help="raw per-node reports instead of the table")
     p = sub.add_parser("trace")
     p.add_argument("id", help="running query id (e.g. view-<name>)")
     p.add_argument("--spans", action="store_true",

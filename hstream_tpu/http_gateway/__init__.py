@@ -17,6 +17,10 @@ Routes (JSON in/out unless noted):
   GET    /overview                    cluster summary + per-stream stats
                                       + flow/shed state + pipeline stages
   GET    /metrics                     Prometheus text exposition
+  GET    /stats?entity=&interval=     per-entity rate-family tables
+                                      (streams|subscriptions|queries x
+                                      1min|10min|1h)
+  GET    /cluster-stats?peers=        federated node load reports
   GET    /events?kind=&since=&limit=  event journal slice
   GET    /streams                     list
   POST   /streams {"name": ...}       create
@@ -181,6 +185,31 @@ class Gateway:
                 if q.get("limit"):
                     args["limit"] = int(q["limit"][0])
                 return 200, self._admin("events", **args)["events"]
+            if path == "/stats" and method == "GET":
+                # Overview stats endpoint (ISSUE 15): per-entity rate
+                # tables off the multi-level ladders — the JSON face
+                # of `admin stats`
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query or "")
+                args = {}
+                if q.get("entity"):
+                    args["entity"] = q["entity"][0]
+                if q.get("interval"):
+                    args["interval"] = q["interval"][0]
+                return 200, self._admin("stats", **args)
+            if path == "/cluster-stats" and method == "GET":
+                # federated per-node load reports (one JSON object per
+                # node, keyed by node name)
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query or "")
+                args = {}
+                if q.get("peers"):
+                    args["peers"] = q["peers"][0]
+                if q.get("timeout_s"):
+                    args["timeout_s"] = float(q["timeout_s"][0])
+                return 200, self._admin("cluster-stats", **args)
             if path == "/swagger.json" and method == "GET":
                 return 200, SWAGGER
             if path == "/streams" and method == "GET":
@@ -486,6 +515,13 @@ SWAGGER = {
                              "Prometheus text exposition"}},
         "/events": {"get": {"summary": "event journal slice "
                                        "(kind/since/limit)"}},
+        "/stats": {"get": {"summary": "per-entity rate-family tables "
+                                      "(entity=streams|subscriptions|"
+                                      "queries, interval=1min|10min|"
+                                      "1h)"}},
+        "/cluster-stats": {"get": {"summary": "federated node load "
+                                              "reports merged across "
+                                              "peers/followers"}},
         "/streams": {"get": {"summary": "list streams"},
                      "post": {"summary": "create stream"}},
         "/streams/{name}": {"delete": {"summary": "delete stream"}},

@@ -44,7 +44,8 @@ class ServerContext:
                  append_lanes: int = DEFAULT_APPEND_LANES,
                  trace_sample: float = 0.0,
                  health_degraded_ms: float | None = None,
-                 health_stalled_ms: float | None = None):
+                 health_stalled_ms: float | None = None,
+                 load_report_interval_ms: float | None = None):
         self.store = store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
@@ -178,6 +179,22 @@ class ServerContext:
         from hstream_tpu.server.scheduler import QuerySupervisor
 
         self.supervisor = QuerySupervisor(self)
+        # cluster stats plane (ISSUE 15): periodic node_load_report
+        # journal events — one bounded holder fold per interval, the
+        # machine-readable load signal the thousand-query placer gates
+        # on. Always on (a node that stops reporting load is invisible
+        # to placement); the interval is tunable for tests/CI.
+        # Constructed here, STARTED by serve() after the port binds —
+        # the boot report must carry the node's real (bound) identity.
+        from hstream_tpu.stats.cluster import (
+            DEFAULT_LOAD_REPORT_INTERVAL_S,
+            LoadReporter,
+        )
+
+        self.load_reporter = LoadReporter(
+            self, interval_s=(DEFAULT_LOAD_REPORT_INTERVAL_S
+                              if load_report_interval_ms is None
+                              else load_report_interval_ms / 1000.0))
         # the checkpoint-log replay above (LogCheckpointStore) happened
         # before the journal existed: surface any corrupt entries it
         # had to skip as a queryable event now
@@ -210,6 +227,12 @@ class ServerContext:
                            "is racing this store")
 
     def shutdown(self) -> None:
+        rep = getattr(self, "load_reporter", None)
+        if rep is not None:
+            try:
+                rep.stop()
+            except Exception:
+                pass
         # stop the supervisor FIRST: a restart racing shutdown would
         # relaunch a task the loop below just stopped
         sup = getattr(self, "supervisor", None)

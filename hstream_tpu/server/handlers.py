@@ -1253,6 +1253,79 @@ class HStreamApiServicer:
                 raise ServerError(
                     f"unknown locks action {action!r} (arm/disarm)")
             out = lt.status()
+        elif cmd == "stats":
+            # declarative-family rate tables (ISSUE 15): one entity
+            # scope per call (streams | subscriptions | queries), every
+            # family's rate at the requested ladder interval plus the
+            # all-time total — the `hadmin server stats` analogue
+            # behind `admin stats` and the gateway's GET /stats
+            from hstream_tpu.stats.families import families_for_scope
+            from hstream_tpu.stats.timeseries import INTERVAL_NAMES
+
+            entity = str(args.get("entity") or "streams")
+            scope = {"streams": "stream", "stream": "stream",
+                     "subscriptions": "subscription",
+                     "subscription": "subscription",
+                     "queries": "query", "query": "query"}.get(entity)
+            if scope is None:
+                raise ServerError(
+                    f"unknown stats entity {entity!r} "
+                    f"(streams|subscriptions|queries)")
+            interval = str(args.get("interval") or "1min")
+            if interval not in INTERVAL_NAMES:
+                raise ServerError(
+                    f"unknown interval {interval!r} "
+                    f"(one of {'|'.join(INTERVAL_NAMES)})")
+            try:
+                fams = families_for_scope(scope)
+            except KeyError as e:
+                raise ServerError(str(e)) from e
+            out = {}
+            keys = {k for f in fams for k in ctx.stats.stat_keys(f.name)}
+            # every scope reports its LIVE topology (GetStats
+            # discipline): a deleted entity's residual ladder — still
+            # present until the next scrape-time stat_drop_stale sweep
+            # — must not resurface through the admin table. "live" is
+            # the one shared definition (cluster.live_entity_keys);
+            # only the reserved overflow fold bypasses it.
+            from hstream_tpu.stats import TS_OVERFLOW_LABEL
+            from hstream_tpu.stats.cluster import live_entity_keys
+
+            live = live_entity_keys(ctx, scope)
+            keys = {k for k in keys
+                    if k in live or k == TS_OVERFLOW_LABEL}
+            for key in sorted(keys):
+                row = {"interval": interval}
+                for f in fams:
+                    lad = ctx.stats.stat_ladder(f.name, key)
+                    row[f"{f.name}_per_s"] = round(lad[interval], 3)
+                    row[f"{f.name}_total"] = lad["total"]
+                out[key] = row
+        elif cmd == "cluster-stats":
+            # federation (ISSUE 15): fan the ClusterStats RPC out to
+            # explicit peers (or this leader's followers) and return
+            # every node's report keyed by node name — `admin
+            # cluster-stats` renders the merged per-node table from it
+            from hstream_tpu.stats import cluster as _cluster
+
+            peers = [a.strip()
+                     for a in str(args.get("peers") or "").split(",")
+                     if a.strip()]
+            timeout = float(args.get("timeout_s") or 5.0)
+            reports = _cluster.collect_cluster(ctx, peers,
+                                               timeout=timeout)
+            # keyed by node name, disambiguated on collision (two
+            # bare followers booted with the default node id must
+            # BOTH stay visible in the merged table, never silently
+            # last-writer-wins)
+            out = {}
+            for i, r in enumerate(reports):
+                key = r.get("node") or r.get("addr") or f"node-{i}"
+                if key in out:
+                    key = f"{key} [{r.get('addr') or i}]"
+                while key in out:
+                    key = f"{key}+"
+                out[key] = r
         elif cmd == "trace-spans":
             # one scope's span ring as Chrome trace-event JSON
             # (GET /queries/<id>/trace, `admin trace --spans`)
@@ -1303,6 +1376,18 @@ class HStreamApiServicer:
         for name in sorted(per_stream):
             out.stats.append(per_stream[name])
         return out
+
+    @unary
+    def ClusterStats(self, request, context):
+        """This node's load report (ISSUE 15): per-stream rate
+        ladders, per-query health, append-front depth, rss — one fold
+        of the stats holder, no device work. The federation fan-out
+        (admin cluster-stats / stats.cluster.collect_cluster) calls
+        this on every peer and merges."""
+        from hstream_tpu.stats import cluster as _cluster
+
+        return pb.ClusterStatsResponse(reports=[
+            _cluster.report_to_pb(_cluster.node_report(self.ctx))])
 
     # ---- plan execution (executeQueryHandler dispatch) ----------------------
 

@@ -57,7 +57,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           locktrace: bool = False,
           trace_sample: float = 0.0,
           health_degraded_ms: float | None = None,
-          health_stalled_ms: float | None = None
+          health_stalled_ms: float | None = None,
+          load_report_interval_ms: float | None = None
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -92,7 +93,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         append_lanes=append_lanes,
                         trace_sample=trace_sample,
                         health_degraded_ms=health_degraded_ms,
-                        health_stalled_ms=health_stalled_ms)
+                        health_stalled_ms=health_stalled_ms,
+                        load_report_interval_ms=load_report_interval_ms)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
         # HSTREAM_FAULTS, which ServerContext already loaded)
@@ -131,6 +133,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     # relaunch tasks and re-emit at-least-once rows before dying
     servicer.resume_persisted()
     server.start()
+    # load reporter starts only now: its boot-time node_load_report
+    # must journal the node's REAL bound identity (host:0 would be a
+    # phantom node the placer can't match to later reports)
+    ctx.load_reporter.start()
     if metrics_port is not None:
         from hstream_tpu.stats.prometheus import serve_exporter
 
@@ -236,6 +242,12 @@ def _parse_args(argv):
                     help="health plane: backlog with no watermark "
                          "advance for this long reads STALLED and "
                          "journals query_stalled (default 30000)")
+    ap.add_argument("--load-report-interval-ms", type=float,
+                    default=None,
+                    help="cadence of the node_load_report journal "
+                         "event (per-stream rate ladders, query "
+                         "health counts, append-front depth, rss — "
+                         "the placement load signal; default 30000)")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -255,7 +267,8 @@ def _parse_args(argv):
                 "locktrace": False,
                 "trace_sample": 0.0,
                 "health_degraded_ms": None,
-                "health_stalled_ms": None}
+                "health_stalled_ms": None,
+                "load_report_interval_ms": None}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -302,7 +315,8 @@ def main(argv=None) -> None:
         locktrace=cfg["locktrace"],
         trace_sample=cfg["trace_sample"],
         health_degraded_ms=cfg["health_degraded_ms"],
-        health_stalled_ms=cfg["health_stalled_ms"])
+        health_stalled_ms=cfg["health_stalled_ms"],
+        load_report_interval_ms=cfg["load_report_interval_ms"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -268,6 +268,19 @@ class SubscriptionRuntime:
             self._maybe_commit()
         if out:
             self._note_delivery(newest, t0)
+            stats = getattr(self.ctx, "stats", None)
+            if stats is not None:
+                try:
+                    # per-subscription delivery ladder (ISSUE 15): the
+                    # rate a consumer group actually drains at — both
+                    # the unary Fetch and the streaming dispatcher
+                    # land here
+                    stats.stat_add("delivered_records", self.sub_id,
+                                   float(len(out)))
+                    stats.stat_add("delivered_bytes", self.sub_id,
+                                   float(sum(len(p) for _r, p in out)))
+                except Exception:  # noqa: BLE001 — metrics must not
+                    pass           # kill delivery
         return out
 
     def _note_delivery(self, newest_append_ms: int, t0: float) -> None:
@@ -305,6 +318,14 @@ class SubscriptionRuntime:
 
     def ack(self, rec_ids: list[RecId],
             consumer: "Consumer | None" = None) -> None:
+        if rec_ids:
+            stats = getattr(self.ctx, "stats", None)
+            if stats is not None:
+                try:
+                    stats.stat_add("acks_received", self.sub_id,
+                                   float(len(rec_ids)))
+                except Exception:  # noqa: BLE001 — metrics must not
+                    pass           # kill the ack path
         with self.lock:
             for rid in rec_ids:
                 self.window.ack(rid)

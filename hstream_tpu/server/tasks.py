@@ -241,6 +241,15 @@ class QueryTask(threading.Thread):
         now = time.time() * 1e3
         qid = self.info.query_id
         try:
+            # per-query emission ladder (ISSUE 15): rows on the wire
+            # and completed close cycles — the query-scoped stat
+            # families the federation fold and `admin stats queries`
+            # serve
+            stats.stat_add("emit_rows", qid, float(len(rows)))
+            stats.stat_add("close_cycles", qid)
+        except Exception:  # noqa: BLE001 — metrics must not kill emit
+            pass
+        try:
             if self._publish_wm_ms >= 0:
                 # append -> visible: the emitted answer now reflects
                 # (at least) everything published up to the watermark

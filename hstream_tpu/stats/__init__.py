@@ -1,4 +1,4 @@
-"""Per-stream stats: counters + multi-level time-series rates.
+"""Per-stream stats: counters + multi-level time-series rate ladders.
 
 Reference: a C++ stats library with thread-local `PerStreamStats`
 (sharded counters aggregated on demand) and folly MultiLevelTimeSeries
@@ -6,10 +6,14 @@ rates, where the metric registry is an X-macro `.inc` file so adding a
 metric is one line (common/clib/stats.h:80-118,
 common/include/per_stream_time_series.inc:24-40).
 
-Here the registry is the two lists below (same one-line property); the
+Here the counter registry is the list below and the rate-ladder
+registry is the declarative family table (stats/families.py — the
+`.inc` analogue, machine-checked by the analyzer's registry pass); the
 holder keeps per-thread counter shards aggregated on read — the GIL
 makes plain dict bumps atomic enough, but sharding keeps the write path
-contention-free and mirrors the reference's aggregation shape.
+contention-free and mirrors the reference's aggregation shape. Rates
+live in fixed-ring MultiLevelTimeSeries (stats/timeseries.py): 60x1s /
+60x10s / 60x60s + all-time, O(1) add, exact windowed recounts.
 """
 
 from __future__ import annotations
@@ -18,6 +22,18 @@ import bisect
 import threading
 import time
 from collections import defaultdict
+
+from hstream_tpu.stats.families import (
+    FAMILY_BY_NAME,
+    STAT_FAMILIES,
+    families_for_scope,
+)
+from hstream_tpu.stats.timeseries import (
+    DEFAULT_LEVELS,
+    INTERVAL_NAMES,
+    MultiLevelTimeSeries,
+    level_for_window,
+)
 
 # ---- metric registry (the .inc analogue: one line per metric) --------------
 
@@ -75,14 +91,14 @@ PER_STREAM_COUNTERS = [
                                # label: lock role name)
 ]
 
+# stream-scoped rate families, in the (name, bucket-widths) tuple
+# shape older consumers (GetStats, the __stats__ virtual table) walk;
+# the declaration itself lives in stats/families.py — subscription- and
+# query-scoped families are reached through the stat_* API only
 PER_STREAM_TIME_SERIES = [
-    # name, bucket seconds per level (reference: 1s/10s/60s multi-level)
-    ("append_in_bytes", (1, 10, 60)),
-    ("append_in_records", (1, 10, 60)),
-    ("record_bytes", (1, 10, 60)),
+    (f.name, tuple(w for w, _n in DEFAULT_LEVELS))
+    for f in families_for_scope("stream")
 ]
-
-_TS_LEVELS = {name: levels for name, levels in PER_STREAM_TIME_SERIES}
 
 # Gauges: point-in-time values sampled from live subsystems. Direct
 # sets (gauge_set) and scrape-time sampling callbacks (gauge_fn) share
@@ -112,6 +128,13 @@ GAUGES = [
                               # stale is the answer a reader sees)
     "query_health_level",     # per query: 0 OK / 1 DEGRADED /
                               # 2 STALLED (the health-plane verdict)
+    "node_rss_bytes",         # resident set size of this server
+                              # process (the federation load signal's
+                              # memory axis), sampled at scrape
+    "append_inflight",        # framed appends submitted to the append
+                              # front but not yet completed (queue
+                              # depth across the lanes / completion
+                              # queue), sampled at scrape
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);
@@ -157,33 +180,12 @@ HIST_LABEL_KEYS = {name: label for name, _b, label in HISTOGRAMS}
 HIST_MAX_LABELS = 512
 HIST_OVERFLOW_LABEL = "_overflow"
 
-
-class TimeSeries:
-    """Sliding-window rate estimator: ring of 1s buckets, queried over
-    any of the registered level windows (MultiLevelTimeSeries shape)."""
-
-    def __init__(self, max_window_s: int = 60):
-        self._max = max_window_s
-        self._buckets: dict[int, float] = {}
-        self._lock = threading.Lock()
-
-    def add(self, value: float, now: float | None = None) -> None:
-        sec = int(now if now is not None else time.time())
-        with self._lock:
-            self._buckets[sec] = self._buckets.get(sec, 0.0) + value
-            if len(self._buckets) > self._max * 2:
-                cutoff = sec - self._max
-                self._buckets = {k: v for k, v in self._buckets.items()
-                                 if k >= cutoff}
-
-    def rate(self, window_s: int, now: float | None = None) -> float:
-        """Per-second rate over the trailing window."""
-        nowi = int(now if now is not None else time.time())
-        lo = nowi - window_s
-        with self._lock:
-            total = sum(v for s, v in self._buckets.items()
-                        if lo < s <= nowi)
-        return total / max(window_s, 1)
+# the rate-ladder series maps get the same ceiling: a client looping
+# over random stream names (a failed Append still notes its bytes)
+# must not grow the series map — or /metrics — without bound; past the
+# cap new keys fold into one overflow series per family
+TS_MAX_LABELS = HIST_MAX_LABELS
+TS_OVERFLOW_LABEL = HIST_OVERFLOW_LABEL
 
 
 class Histogram:
@@ -262,7 +264,7 @@ class StatsHolder:
         self._shards: list[_Shard] = []
         self._shards_lock = threading.Lock()
         self._retired: dict[tuple[str, str], int] = defaultdict(int)
-        self._series: dict[tuple[str, str], TimeSeries] = {}
+        self._series: dict[tuple[str, str], MultiLevelTimeSeries] = {}
         self._series_lock = threading.Lock()
         # gauges: direct values + scrape-time sampling callbacks; both
         # keyed (metric, label). A dead callback (its subsystem went
@@ -328,48 +330,127 @@ class StatsHolder:
                     out[stream] += v
         return dict(out)
 
-    # ---- time series ----
-    def _ts(self, metric: str, stream: str) -> TimeSeries:
-        if metric not in _TS_LEVELS:
-            raise KeyError(f"unregistered time series {metric!r}")
-        key = (metric, stream)
+    # ---- rate ladders (declarative stat families) ----
+    def _family_series(self, family: str, key: str
+                       ) -> MultiLevelTimeSeries:
+        """The (family, key) ladder, created from the family table on
+        first write. Past TS_MAX_LABELS keys per family, new keys fold
+        into the one overflow series — the series map (and /metrics)
+        stays bounded no matter what key junk a client sends."""
+        if family not in FAMILY_BY_NAME:
+            raise KeyError(f"unregistered stat family {family!r}")
+        k = (family, key)
         with self._series_lock:
-            ts = self._series.get(key)
+            ts = self._series.get(k)
             if ts is None:
-                ts = TimeSeries(max(_TS_LEVELS[metric]))
-                self._series[key] = ts
+                n = sum(1 for (f, _key) in self._series if f == family)
+                if n >= TS_MAX_LABELS:
+                    k = (family, TS_OVERFLOW_LABEL)
+                    ts = self._series.get(k)
+                    if ts is not None:
+                        return ts
+                ts = MultiLevelTimeSeries()
+                self._series[k] = ts
             return ts
+
+    def stat_add(self, family: str, key: str, value: float = 1.0,
+                 now: float | None = None) -> None:
+        """THE family write path (the reference's `.inc` bump): one
+        O(1) ladder add. Call sites are machine-checked against the
+        family table by the analyzer's `registry-family` rule."""
+        self._family_series(family, key).add(value, now)
+
+    def _peek_series(self, family: str, key: str
+                     ) -> MultiLevelTimeSeries | None:
+        """Read-only lookup: monitoring reads must not allocate/retain
+        state on the holder. An UNREGISTERED family raises the same
+        KeyError `_family_series` does: a typo'd dashboard query must
+        not read as a silent zero."""
+        if family not in FAMILY_BY_NAME:
+            raise KeyError(f"unregistered stat family {family!r}")
+        with self._series_lock:
+            return self._series.get((family, key))
+
+    def stat_rate(self, family: str, key: str, interval="1min",
+                  now: float | None = None) -> float:
+        ts = self._peek_series(family, key)
+        return 0.0 if ts is None else ts.rate(interval, now)
+
+    def stat_sum(self, family: str, key: str, interval="1min",
+                 now: float | None = None) -> float:
+        ts = self._peek_series(family, key)
+        return 0.0 if ts is None else ts.sum(interval, now)
+
+    def stat_avg(self, family: str, key: str, interval="1min",
+                 now: float | None = None) -> float:
+        ts = self._peek_series(family, key)
+        return 0.0 if ts is None else ts.avg(interval, now)
+
+    def stat_count(self, family: str, key: str, interval="1min",
+                   now: float | None = None) -> int:
+        ts = self._peek_series(family, key)
+        return 0 if ts is None else ts.count(interval, now)
+
+    def stat_ladder(self, family: str, key: str,
+                    now: float | None = None) -> dict[str, float]:
+        """Every interval's rate + all-time sum/count for one series
+        (zeros when the key has never been written)."""
+        ts = self._peek_series(family, key)
+        if ts is None:
+            # same shape ladder() returns, derived from the declared
+            # interval set so a level rename cannot fork cold keys
+            return {**dict.fromkeys(INTERVAL_NAMES, 0.0),
+                    "total": 0.0, "total_count": 0.0}
+        return ts.ladder(now)
+
+    def stat_keys(self, family: str) -> list[str]:
+        """Keys with a live ladder for `family` (exposition and the
+        federation fold walk this instead of the series map)."""
+        if family not in FAMILY_BY_NAME:
+            raise KeyError(f"unregistered stat family {family!r}")
+        with self._series_lock:
+            return sorted({k for (f, k) in self._series if f == family})
+
+    def stat_drop_stale(self, scope: str, live: set[str]) -> None:
+        """Drop every ladder of `scope`-scoped families whose entity
+        no longer exists — the gauge `_drop_stale` discipline for the
+        family series, run at scrape time. This is also what frees
+        TS_MAX_LABELS cap slots: without it, entity churn would
+        permanently fill a family's cap with retired keys and fold
+        every NEW entity into the overflow series. ONLY the reserved
+        overflow fold is exempt — a broader "_" exemption would let a
+        client churning "_"-named entities exhaust the cap forever."""
+        fams = {f.name for f in families_for_scope(scope)}
+        with self._series_lock:
+            stale = [k for k in self._series
+                     if k[0] in fams and k[1] != TS_OVERFLOW_LABEL
+                     and k[1] not in live]
+            for k in stale:
+                del self._series[k]
+
+    # back-compat shims over the family API (older call sites/tests;
+    # `window_s` picks the narrowest level ladder covering it)
+    def _ts(self, metric: str, stream: str) -> MultiLevelTimeSeries:
+        return self._family_series(metric, stream)
 
     def time_series_add(self, metric: str, stream: str, value: float
                         ) -> None:
-        self._ts(metric, stream).add(value)
+        self.stat_add(metric, stream, value)
 
     def time_series_get_rate(self, metric: str, stream: str,
                              window_s: int | None = None) -> float:
-        levels = _TS_LEVELS[metric]
-        return self._ts(metric, stream).rate(window_s or levels[-1])
+        return self._family_series(metric, stream).rate(
+            level_for_window(window_s or 60))
 
     def time_series_streams(self, metric: str) -> list[str]:
-        """Streams with a live series for `metric` (exposition walks
-        this instead of reaching into the series map)."""
-        if metric not in _TS_LEVELS:
-            raise KeyError(f"unregistered time series {metric!r}")
-        with self._series_lock:
-            return sorted({s for (m, s) in self._series if m == metric})
+        return self.stat_keys(metric)
 
     def time_series_peek_rate(self, metric: str, stream: str,
                               window_s: int | None = None) -> float:
-        """Read-only rate: 0.0 when no series exists for the stream —
-        monitoring reads must not allocate/retain state on the holder.
-        An UNREGISTERED metric raises the same KeyError `_ts` does: a
-        typo'd dashboard query must not read as a silent zero."""
-        if metric not in _TS_LEVELS:
-            raise KeyError(f"unregistered time series {metric!r}")
-        with self._series_lock:
-            ts = self._series.get((metric, stream))
+        ts = self._peek_series(metric, stream)
         if ts is None:
             return 0.0
-        return ts.rate(window_s or _TS_LEVELS[metric][-1])
+        return ts.rate(level_for_window(window_s or 60))
 
     # ---- gauges ----
     def gauge_set(self, metric: str, label: str, value: float) -> None:
@@ -473,11 +554,11 @@ class StatsHolder:
     def note_append(self, stream: str, n_records: int, n_bytes: int) -> None:
         self.stream_stat_add("append_total", stream)
         self.stream_stat_add("append_payload_bytes", stream, n_bytes)
-        ts = self._ts("append_in_bytes", stream)
-        ts.add(float(n_bytes))
-        self._ts("append_in_records", stream).add(float(n_records))
+        self.stat_add("append_in_bytes", stream, float(n_bytes))
+        self.stat_add("append_in_records", stream, float(n_records))
 
     def note_read(self, stream: str, n_records: int, n_bytes: int) -> None:
         self.stream_stat_add("record_total", stream, n_records)
         self.stream_stat_add("record_payload_bytes", stream, n_bytes)
-        self._ts("record_bytes", stream).add(float(n_bytes))
+        self.stat_add("record_bytes", stream, float(n_bytes))
+        self.stat_add("read_out_records", stream, float(n_records))

@@ -52,6 +52,11 @@ EVENT_KINDS = [
                          # saw both directions of a lock pair — a
                          # potential deadlock reported WITHOUT needing
                          # the unlucky schedule (GoodLock)
+    "node_load_report",  # periodic per-node load fold (stats/cluster):
+                         # per-stream append rates, query health
+                         # counts, append-front depth, rss — THE
+                         # machine-readable load signal the thousand-
+                         # query placer gates on (ROADMAP item 2)
 ]
 
 

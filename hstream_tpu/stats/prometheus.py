@@ -25,8 +25,10 @@ from hstream_tpu.stats import (
     GAUGES,
     HIST_LABEL_KEYS,
     PER_STREAM_COUNTERS,
-    PER_STREAM_TIME_SERIES,
+    TS_OVERFLOW_LABEL,
 )
+from hstream_tpu.stats.families import STAT_FAMILIES, families_for_scope
+from hstream_tpu.stats.timeseries import INTERVAL_NAMES
 
 PREFIX = "hstream"
 
@@ -88,9 +90,12 @@ _HELP = {
                         "drain paths",
     "factory_recompiles": "XLA executable builds attributed to the "
                           "kernel family whose dispatch triggered them",
-    "append_in_bytes": "append byte rate over the trailing window",
-    "append_in_records": "append record rate over the trailing window",
-    "record_bytes": "read byte rate over the trailing window",
+    "stream_rate": "per-stream family rate ladder: records|bytes per "
+                   "second over the named trailing interval "
+                   "(1min/10min/1h), sampled at scrape",
+    "node_rss_bytes": "resident set size of this server process",
+    "append_inflight": "framed appends submitted to the append front "
+                       "but not yet completed",
     "pipeline_occupancy": "ingest pipeline busy fraction per query",
     "pipeline_reorder_depth": "staged-but-unstepped batches per query",
     "sub_backlog": "subscription lag in LSNs (tail - committed)",
@@ -133,6 +138,10 @@ _HELP = {
     "lock_hold_ms": "time each named traced lock was held per "
                     "critical section (lock-order witness armed)",
 }
+
+# rate-family HELP text lives on the declaration itself (the one-line
+# `.inc` property: declaring a family brings its exposition docs)
+_HELP.update({f.name: f.help for f in STAT_FAMILIES})
 
 
 def escape_label_value(v: str) -> str:
@@ -196,15 +205,39 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
                         and stream not in live_streams):
                     continue
             lines.append(_series(name, {"stream": stream}, v))
-    for metric, _levels in PER_STREAM_TIME_SERIES:
-        name = f"{PREFIX}_{metric}_rate"
-        _header(lines, name, "gauge", metric)
-        for stream in stats.time_series_streams(metric):
-            if live_streams is not None and stream not in live_streams:
+    for fam in STAT_FAMILIES:
+        name = f"{PREFIX}_{fam.name}_rate"
+        _header(lines, name, "gauge", fam.name)
+        for key in stats.stat_keys(fam.name):
+            # ONLY the reserved overflow fold is exempt from liveness
+            # filtering: the bounded-cardinality aggregate must stay
+            # visible exactly when the cap engages (a broader "_"
+            # exemption would let "_"-named entities render forever)
+            if key != TS_OVERFLOW_LABEL:
+                if fam.scope == "stream" and live_streams is not None \
+                        and key not in live_streams:
+                    continue
+                if fam.scope == "query" and live_queries is not None \
+                        and key not in live_queries:
+                    continue
+            lines.append(_series(name, {fam.scope: key},
+                                 stats.stat_rate(fam.name, key)))
+    # the multi-interval ladder of every stream-scoped family in one
+    # place: stream_rate{stream,metric,interval} — cardinality bounded
+    # by the per-family series cap (TS_MAX_LABELS overflow fold), 3
+    # intervals per (stream, family) pair
+    name = f"{PREFIX}_stream_rate"
+    _header(lines, name, "gauge", "stream_rate")
+    for fam in families_for_scope("stream"):
+        for key in stats.stat_keys(fam.name):
+            if live_streams is not None and key not in live_streams \
+                    and key != TS_OVERFLOW_LABEL:
                 continue
-            lines.append(_series(
-                name, {"stream": stream},
-                stats.time_series_peek_rate(metric, stream)))
+            for interval in INTERVAL_NAMES:
+                lines.append(_series(
+                    name, {"stream": key, "metric": fam.name,
+                           "interval": interval},
+                    stats.stat_rate(fam.name, key, interval)))
     gauges = stats.gauges_snapshot()
     for metric in GAUGES:
         entries = sorted((label, v) for (m, label), v in gauges.items()
@@ -366,6 +399,34 @@ def sample_gauges(ctx) -> None:
         sample_health(ctx)
     except Exception:  # noqa: BLE001 — a half-built context (tests
         pass           # construct bare ones) must not fail the scrape
+    # retire rate ladders whose entity is gone (ISSUE 15, the
+    # _drop_stale discipline for family series): a deleted stream /
+    # subscription / query must stop rendering AND free its
+    # TS_MAX_LABELS cap slot, or entity churn folds every new entity
+    # into the overflow series. Each scope fails open independently
+    # (a half-built test context must not fail the scrape); "live"
+    # is defined ONCE (cluster.live_entity_keys) for the sweep, the
+    # admin stats verb, and the render filters alike.
+    from hstream_tpu.stats.cluster import live_entity_keys
+
+    for scope in ("stream", "subscription", "query"):
+        try:
+            stats.stat_drop_stale(scope, live_entity_keys(ctx, scope))
+        except Exception:  # noqa: BLE001
+            pass
+    # node load axes for the federation fold (ISSUE 15): process rss +
+    # append-front queue depth — the same numbers NodeStatsReport and
+    # the periodic node_load_report event carry
+    from hstream_tpu.stats.cluster import rss_bytes
+
+    stats.gauge_set("node_rss_bytes", "", rss_bytes())
+    front = getattr(ctx, "append_front", None)
+    if front is not None:
+        try:
+            stats.gauge_set("append_inflight", "",
+                            front.stats().get("in_flight", 0))
+        except Exception:  # noqa: BLE001 — a closing front must not
+            pass           # fail the scrape
     # durable store footprint (native store roots at a directory)
     root = getattr(ctx.store, "root", None) \
         or getattr(getattr(ctx.store, "local", None), "root", None)
@@ -392,15 +453,16 @@ def render_metrics(ctx) -> str:
     Whole-scrape serialization (holder.scrape_lock): concurrent
     scrapers otherwise race sample_gauges' stale-series sweep against
     each other and intermittently drop live gauges."""
+    from hstream_tpu.stats.cluster import live_entity_keys
+
     with ctx.stats.scrape_lock:
         sample_gauges(ctx)
         try:
-            live = set(ctx.streams.find_streams())
+            live = live_entity_keys(ctx, "stream")
         except Exception:  # noqa: BLE001
             live = None
         try:
-            queries = {q.query_id
-                       for q in ctx.persistence.get_queries()}
+            queries = live_entity_keys(ctx, "query")
         except Exception:  # noqa: BLE001 — fail open, like streams
             queries = None
         return render_holder(ctx.stats, live_streams=live,

@@ -1190,6 +1190,31 @@ class FollowerService:
                              if self._is_leader
                              else self._leader_hint or ""))
 
+    def ClusterStats(self, request, context):
+        """Federation face of a BARE follower process (ISSUE 15): no
+        stats holder lives here, so the report carries the load axes a
+        follower has — role, op-log position, rss — keeping the node
+        visible in the merged `admin cluster-stats` table instead of
+        reading as unreachable."""
+        import json as _json
+        import time as _time
+
+        from hstream_tpu.stats.cluster import rss_bytes
+
+        with self._lock:
+            applied, is_leader = self.applied_seq, self._is_leader
+            epoch = self._epoch
+        role = "leader" if is_leader else "follower"
+        report = {"node": self.node_id, "addr": self.listen_addr,
+                  "role": role, "ts_ms": int(_time.time() * 1000),
+                  "rss_bytes": rss_bytes(), "running_queries": 0,
+                  "append_inflight": 0, "applied_seq": applied,
+                  "epoch": epoch, "streams": {}, "queries": {}}
+        return pb.ClusterStatsResponse(reports=[pb.NodeStatsReport(
+            node=self.node_id, role=role, ts_ms=report["ts_ms"],
+            rss_bytes=report["rss_bytes"],
+            report=_json.dumps(report))])
+
     # ---- promotion ---------------------------------------------------------
 
     def Promote(self, request, context):

@@ -1044,6 +1044,52 @@ def test_registry_stage_clean_on_live_tree():
     assert out == [], out
 
 
+def test_registry_family_call_sites_checked():
+    """ISSUE 15 satellite: stat-family call sites are checked against
+    the declared table (stats/families.STAT_FAMILIES) — the X-macro
+    property, enforced: an undeclared family name is a finding, not a
+    runtime KeyError on a cold path."""
+    code = '''
+    def f(stats):
+        stats.stat_add("no_such_family_xyz", "s", 1.0)     # undeclared
+        stats.stat_add("append_in_bytes", "s", 1.0)        # declared
+        stats.stat_rate("deliverred_records", "sub")       # typo'd
+        stats.stat_rate("delivered_records", "sub")        # declared
+        stats.stat_ladder("emit_rows", "q1")               # declared
+        stats.stat_sum("close_cycle", "q1")                # typo'd
+    '''
+    out = run_one(registry, [src("hstream_tpu/fixture.py", code)])
+    fam = [f for f in out if f.rule == "registry-family"]
+    assert len(fam) == 3, fam
+    assert any("no_such_family_xyz" in f.message for f in fam)
+    assert any("deliverred_records" in f.message for f in fam)
+    assert any("close_cycle" in f.message for f in fam)
+    # declared families never misreport under the legacy rule either
+    assert not any("append_in_bytes" in f.message for f in out
+                   if f.rule in ("registry-family", "registry-unknown"))
+
+
+def test_registry_family_dead_entry_flagged():
+    """Direction 2 covers the family table too: a declared family no
+    call site feeds is a dead registry entry."""
+    out = run_one(registry, [src("hstream_tpu/fixture.py", "x = 1\n")])
+    dead = [f for f in out if f.rule == "registry-dead"]
+    assert any("delivered_records" in f.message for f in dead)
+    assert any("emit_rows" in f.message for f in dead)
+
+
+def test_registry_family_clean_on_live_tree():
+    """Every stat-family literal in the production tree names a
+    declared family, and every declared family has a live call site."""
+    from tools.analyze import load_tree
+
+    out = [f for f in registry.run(load_tree(REPO), REPO)
+           if f.rule == "registry-family"
+           or (f.rule == "registry-dead"
+               and "time_series" in f.message)]
+    assert out == [], out
+
+
 # ---- dispatch (ISSUE 7) ----------------------------------------------------
 
 

@@ -1,0 +1,477 @@
+"""Cluster stats plane tests (ISSUE 15): MultiLevelTimeSeries
+exactness against brute-force recounts (rotation, idle gaps, level
+boundaries, late adds), the declarative-family admin verbs, the
+gateway /stats endpoint, the periodic node_load_report journal event,
+and a seeded 3-node federation merge whose per-node per-stream rates
+must match direct recounts exactly."""
+
+import json
+import random
+import urllib.request
+
+import grpc
+import pytest
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.http_gateway import serve_gateway
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+from hstream_tpu.server.main import serve
+from hstream_tpu.stats import StatsHolder
+from hstream_tpu.stats.timeseries import (
+    DEFAULT_LEVELS,
+    INTERVAL_NAMES,
+    MultiLevelTimeSeries,
+)
+
+BASE_S = 1_700_000_000
+
+
+# ---- multilevel exactness vs brute force -----------------------------------
+
+
+def _brute_window(adds, now, width, n):
+    """Reference recount: (sum, count) of adds whose second lands in
+    the trailing ``n`` bucket slots of width ``width`` aligned to the
+    bucket grid — the exact semantics the rings implement."""
+    cur = int(now) // width
+    lo = cur - n + 1
+    hits = [v for t, v in adds if lo <= int(t) // width <= cur]
+    return sum(hits), len(hits)
+
+
+def test_multilevel_exactness_random_walk():
+    """2000 seeded adds over a time walk mixing sub-bucket steps,
+    level-boundary hops, and idle gaps wider than every ring; at
+    checkpoints every level's sum/count/rate must equal the brute-force
+    recount exactly (not approximately)."""
+    rng = random.Random(0xC1A5)
+    ts = MultiLevelTimeSeries()
+    adds = []
+    t = float(BASE_S)
+    qmax = t
+    for step in range(2000):
+        t += rng.choice([0.0, 0.0, 0.3, 0.7, 1.0, 1.0, 2.0, 9.0,
+                         10.0, 59.0, 60.0, 61.0, 599.0, 601.0, 3601.0])
+        v = float(rng.randint(1, 1000))
+        ts.add(v, now=t)
+        adds.append((t, v))
+        if step % 37 == 0:
+            # queries are monotone (rings only rotate forward); a
+            # later add BEFORE the queried now is a late add and must
+            # still land in its exact bucket
+            q = max(t, qmax) + rng.choice([0.0, 0.5, 1.0, 30.0, 120.0])
+            qmax = q
+            for name, (w, n) in zip(INTERVAL_NAMES, DEFAULT_LEVELS):
+                want_sum, want_count = _brute_window(adds, q, w, n)
+                assert ts.sum(name, now=q) == want_sum, (step, name)
+                assert ts.count(name, now=q) == want_count, (step, name)
+                assert ts.rate(name, now=q) == want_sum / (w * n)
+    total_sum, total_count = ts.all_time()
+    assert total_sum == sum(v for _t, v in adds)
+    assert total_count == len(adds)
+
+
+def test_multilevel_idle_gap_clears_narrow_keeps_wide():
+    ts = MultiLevelTimeSeries()
+    for i in range(10):
+        ts.add(2.0, now=BASE_S + i)
+    # 90s later: outside the 1min ring, inside 10min and 1h
+    q = BASE_S + 9 + 90
+    assert ts.sum("1min", now=q) == 0.0
+    assert ts.sum("10min", now=q) == 20.0
+    assert ts.sum("1h", now=q) == 20.0
+    # 11 minutes later: only the 1h ring still holds the adds
+    q = BASE_S + 9 + 660
+    assert ts.sum("10min", now=q) == 0.0
+    assert ts.sum("1h", now=q) == 20.0
+    assert ts.all_time() == (20.0, 10)
+
+
+def test_multilevel_late_add_lands_in_its_bucket():
+    ts = MultiLevelTimeSeries()
+    ts.add(1.0, now=BASE_S + 30)
+    # a late add 5s in the past still belongs to the 1min window
+    ts.add(4.0, now=BASE_S + 25)
+    assert ts.sum("1min", now=BASE_S + 30) == 5.0
+    # a late add older than the whole 1min ring is dropped from that
+    # level but kept by the wider rings and the all-time sum
+    ts.add(8.0, now=BASE_S - 40)
+    assert ts.sum("1min", now=BASE_S + 30) == 5.0
+    assert ts.sum("10min", now=BASE_S + 30) == 13.0
+    assert ts.all_time() == (13.0, 3)
+
+
+def test_multilevel_avg_and_interval_names():
+    ts = MultiLevelTimeSeries()
+    for v in (2.0, 4.0, 6.0):
+        ts.add(v, now=BASE_S)
+    assert ts.avg("1min", now=BASE_S) == 4.0
+    ladder = ts.ladder(now=BASE_S)
+    assert set(ladder) == {"1min", "10min", "1h", "total",
+                           "total_count"}
+    assert ladder["total"] == 12.0
+    with pytest.raises(KeyError):
+        ts.sum("5min")
+
+
+def test_holder_family_api_rejects_undeclared():
+    stats = StatsHolder()
+    with pytest.raises(KeyError):
+        stats.stat_add("no_such_family", "k")
+    with pytest.raises(KeyError):
+        stats.stat_rate("no_such_family", "k")
+    with pytest.raises(KeyError):
+        stats.stat_keys("no_such_family")
+    # declared-but-unseen keys peek 0.0 without allocating
+    assert stats.stat_rate("delivered_records", "nope") == 0.0
+    assert stats.stat_keys("delivered_records") == []
+
+
+# ---- admin verbs + gateway /stats on a live server -------------------------
+
+
+@pytest.fixture(scope="module")
+def stack():
+    server, ctx = serve("127.0.0.1", 0, "mem://",
+                        load_report_interval_ms=400)
+    addr = f"127.0.0.1:{ctx.port}"
+    httpd, gw = serve_gateway(addr, port=0)
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    channel = grpc.insecure_channel(addr)
+    stub = HStreamApiStub(channel)
+    yield addr, base, stub, ctx
+    channel.close()
+    httpd.shutdown()
+    gw.close()
+    server.stop(grace=1)
+    ctx.shutdown()
+
+
+def _admin(stub, command, **kwargs):
+    resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+        command=command, args=rec.dict_to_struct(kwargs)))
+    return json.loads(resp.result)
+
+
+def _append(stub, stream, rows):
+    req = pb.AppendRequest(stream_name=stream)
+    for i in range(rows):
+        req.records.append(rec.build_record({"k": "a", "v": i}))
+    stub.Append(req)
+
+
+def test_admin_stats_verbs_all_scopes(stack):
+    addr, base, stub, ctx = stack
+    stub.CreateStream(pb.Stream(stream_name="cs1"))
+    _append(stub, "cs1", 6)
+    # streams table: every stream-scoped family at the 1min ladder,
+    # the record rate matching the appended count exactly
+    out = _admin(stub, "stats", entity="streams", interval="1min")
+    row = out["cs1"]
+    assert row["interval"] == "1min"
+    assert row["append_in_records_total"] == 6.0
+    assert row["append_in_records_per_s"] == round(6.0 / 60.0, 3)
+    assert row["append_in_bytes_total"] == \
+        ctx.stats.stream_stat_get("append_payload_bytes", "cs1")
+    # subscription scope: a fetch feeds the delivered_* families
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="cssub", stream_name="cs1"))
+    got = stub.Fetch(pb.FetchRequest(subscription_id="cssub",
+                                     timeout_ms=500, max_size=64))
+    n = len(got.received_records)
+    assert n == 6
+    stub.Acknowledge(pb.AcknowledgeRequest(
+        subscription_id="cssub",
+        ack_ids=[r.record_id for r in got.received_records]))
+    out = _admin(stub, "stats", entity="subscriptions")
+    assert out["cssub"]["delivered_records_total"] == float(n)
+    assert out["cssub"]["acks_received_total"] == float(n)
+    # queries scope exists even while empty; the 10min/1h intervals
+    # and bad inputs are typed refusals, not 500s
+    assert _admin(stub, "stats", entity="queries") == {}
+    assert _admin(stub, "stats", entity="streams",
+                  interval="10min")["cs1"]["interval"] == "10min"
+    with pytest.raises(grpc.RpcError):
+        _admin(stub, "stats", entity="nonsense")
+    with pytest.raises(grpc.RpcError):
+        _admin(stub, "stats", interval="5min")
+    stub.DeleteSubscription(pb.DeleteSubscriptionRequest(
+        subscription_id="cssub"))
+
+
+def test_admin_cli_stats_table(stack):
+    """The CLI face: `admin stats` renders the verb output with the
+    scope label as the first column."""
+    from argparse import Namespace
+
+    from hstream_tpu.admin import cmd_stats
+
+    rows = cmd_stats(stub=stack[2],
+                     args=Namespace(entity="streams", interval="1min",
+                                    json=False))
+    assert any(r.get("stream") == "cs1" for r in rows)
+    row = next(r for r in rows if r.get("stream") == "cs1")
+    assert "append_in_records_per_s" in row
+
+
+def test_gateway_stats_endpoint(stack):
+    addr, base, stub, ctx = stack
+    with urllib.request.urlopen(f"{base}/stats?entity=streams"
+                                f"&interval=1min") as r:
+        assert r.status == 200
+        out = json.loads(r.read())
+    assert "cs1" in out
+    assert out["cs1"]["interval"] == "1min"
+    with urllib.request.urlopen(f"{base}/cluster-stats") as r:
+        nodes = json.loads(r.read())
+    (rep,) = nodes.values()
+    assert rep["streams"]["cs1"]["append_in_records"]["total"] == 6.0
+    assert rep["rss_bytes"] > 0
+
+
+def test_metrics_carries_stream_rate_ladder(stack):
+    addr, base, stub, ctx = stack
+    from hstream_tpu.stats.prometheus import render_metrics
+
+    text = render_metrics(ctx)
+    for interval in INTERVAL_NAMES:
+        assert (f'hstream_stream_rate{{stream="cs1",'
+                f'metric="append_in_records",interval="{interval}"}}'
+                in text)
+    assert "hstream_node_rss_bytes" in text
+    assert "hstream_append_inflight" in text
+
+
+def test_node_load_report_journal_event(stack):
+    addr, base, stub, ctx = stack
+    import time
+
+    deadline = time.time() + 10
+    events = []
+    while time.time() < deadline:
+        events = ctx.events.query(kind="node_load_report", limit=10)
+        if events:
+            break
+        time.sleep(0.1)
+    assert events, "no node_load_report journaled"
+    ev = events[-1]
+    for field in ("node", "rss_bytes", "running_queries",
+                  "append_inflight", "health", "streams"):
+        assert field in ev, ev
+    assert ev["rss_bytes"] > 0
+    # the admin events verb sees it too (the placer's query path)
+    out = _admin(stub, "events", kind="node_load_report", limit=5)
+    assert out["events"]
+
+
+def test_stale_family_series_dropped_at_scrape(stack):
+    """A deleted entity's rate ladder stops rendering AND frees its
+    cap slot: the scrape-time stat_drop_stale sweep is what keeps
+    entity churn from folding every new entity into _overflow."""
+    addr, base, stub, ctx = stack
+    from hstream_tpu.stats.prometheus import render_metrics
+
+    stub.CreateStream(pb.Stream(stream_name="tmp-s"))
+    stub.CreateSubscription(pb.Subscription(
+        subscription_id="tmpsub", stream_name="tmp-s"))
+    _append(stub, "tmp-s", 3)
+    got = stub.Fetch(pb.FetchRequest(subscription_id="tmpsub",
+                                     timeout_ms=500, max_size=16))
+    assert len(got.received_records) == 3
+    assert "tmpsub" in ctx.stats.stat_keys("delivered_records")
+    assert "tmp-s" in ctx.stats.stat_keys("append_in_records")
+    # the admin table hides a just-deleted entity even BEFORE a scrape
+    stub.DeleteSubscription(pb.DeleteSubscriptionRequest(
+        subscription_id="tmpsub"))
+    stub.DeleteStream(pb.DeleteStreamRequest(stream_name="tmp-s"))
+    assert "tmpsub" not in _admin(stub, "stats", entity="subscriptions")
+    assert "tmp-s" not in _admin(stub, "stats", entity="streams")
+    # the scrape sweep retires the storage itself
+    render_metrics(ctx)
+    assert "tmpsub" not in ctx.stats.stat_keys("delivered_records")
+    assert "tmpsub" not in ctx.stats.stat_keys("delivered_bytes")
+    assert "tmp-s" not in ctx.stats.stat_keys("append_in_records")
+    # "_"-prefixed pseudo-keys survive the sweep (the overflow fold)
+    ctx.stats.stat_add("append_in_bytes", "_overflow", 1.0)
+    render_metrics(ctx)
+    assert "_overflow" in ctx.stats.stat_keys("append_in_bytes")
+
+
+# ---- seeded 3-node federation ----------------------------------------------
+
+
+def test_three_node_federation_merge_exact():
+    """Three in-process servers, seeded per-node append counts; `admin
+    cluster-stats` against node 0 with --peers must return one report
+    per node whose per-stream 1min/10min rates equal the direct
+    recounts exactly — including a same-named stream on two nodes
+    staying attributed per node, never re-aggregated."""
+    rng = random.Random(42)
+    nodes = []
+    try:
+        for i in range(3):
+            server, ctx = serve("127.0.0.1", 0, "mem://",
+                                load_report_interval_ms=60_000)
+            addr = f"127.0.0.1:{ctx.port}"
+            ch = grpc.insecure_channel(addr)
+            nodes.append((server, ctx, addr, ch, HStreamApiStub(ch)))
+        counts = []
+        for i, (_s, _c, _a, _ch, stub) in enumerate(nodes):
+            k = rng.randint(3, 9)
+            stub.CreateStream(pb.Stream(stream_name=f"fed-s{i}"))
+            _append(stub, f"fed-s{i}", k)
+            shared = 0
+            if i < 2:  # same stream name on two nodes, different load
+                shared = rng.randint(2, 7) + i * 10
+                stub.CreateStream(pb.Stream(stream_name="fed-shared"))
+                _append(stub, "fed-shared", shared)
+            counts.append((k, shared))
+        stub0 = nodes[0][4]
+        peers = ",".join(a for _s, _c, a, _ch, _stub in nodes[1:])
+        merged = _admin(stub0, "cluster-stats", peers=peers)
+        assert len(merged) == 3, list(merged)
+        by_addr = {rep["addr"]: rep for rep in merged.values()}
+        for i, (_s, ctx, addr, _ch, _stub) in enumerate(nodes):
+            rep = by_addr[addr]
+            assert "error" not in rep
+            k, shared = counts[i]
+            lad = rep["streams"][f"fed-s{i}"]["append_in_records"]
+            # exact recount: every append landed inside the trailing
+            # 1min window, so the ladder sums to exactly k
+            assert lad["total"] == float(k)
+            assert lad["1min"] == k / 60.0
+            assert lad["10min"] == k / 600.0
+            if shared:
+                sl = rep["streams"]["fed-shared"]["append_in_records"]
+                assert sl["total"] == float(shared)
+                assert sl["1min"] == shared / 60.0
+            # byte ladder cross-checked against the counter registry
+            assert rep["streams"][f"fed-s{i}"]["append_in_bytes"][
+                "total"] == ctx.stats.stream_stat_get(
+                    "append_payload_bytes", f"fed-s{i}")
+        # the two fed-shared loads stayed per-node
+        s0 = by_addr[nodes[0][2]]["streams"]["fed-shared"][
+            "append_in_records"]["total"]
+        s1 = by_addr[nodes[1][2]]["streams"]["fed-shared"][
+            "append_in_records"]["total"]
+        assert s0 == float(counts[0][1]) and s1 == float(counts[1][1])
+        assert s0 != s1
+        # the merged table shape: 3 node rows + one row per
+        # (node, stream), rates carried at the requested interval
+        from hstream_tpu.stats.cluster import merge_rows
+
+        rows = merge_rows(list(merged.values()), interval="1min")
+        node_rows = [r for r in rows if r["stream"] == "(node)"]
+        assert len(node_rows) == 3
+        stream_rows = [(r["node"], r["stream"]) for r in rows
+                       if r["stream"] != "(node)"]
+        assert len(stream_rows) == len(set(stream_rows)) == 5
+        # a dead peer reads as an unreachable row, not a missing one
+        dead = _admin(stub0, "cluster-stats",
+                      peers="127.0.0.1:1", timeout_s=1.0)
+        assert any(r.get("role") == "unreachable"
+                   for r in dead.values())
+    finally:
+        for server, ctx, _a, ch, _stub in nodes:
+            ch.close()
+            server.stop(grace=1)
+            ctx.shutdown()
+
+
+def test_node_load_report_carries_bound_identity(stack):
+    """The boot-time report journals the REAL bound address: a
+    reporter started before the ephemeral-port bind would journal a
+    phantom `host:0` node the placer can't match to later reports."""
+    addr, base, stub, ctx = stack
+    events = ctx.events.query(kind="node_load_report", limit=1000)
+    assert events
+    for ev in events:
+        assert ev["addr"] == addr, ev["addr"]
+        assert not ev["addr"].endswith(":0")
+
+
+def test_cluster_stats_merge_disambiguates_node_name_collisions():
+    """Two bare followers with the default node id must BOTH stay
+    visible in the merged table — never silently last-writer-wins."""
+    import socket
+
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import serve_follower
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    server, ctx = serve("127.0.0.1", 0, "mem://")
+    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    stub = HStreamApiStub(ch)
+    stores, followers = [], []
+    try:
+        peers = []
+        for _ in range(2):
+            st = open_store("mem://")
+            port = free_port()
+            fs, svc = serve_follower(st, f"127.0.0.1:{port}",
+                                     node_id="follower")
+            stores.append(st)
+            followers.append((fs, svc))
+            peers.append(f"127.0.0.1:{port}")
+        merged = _admin(stub, "cluster-stats", peers=",".join(peers))
+        assert len(merged) == 3, list(merged)
+        roles = sorted(r["role"] for r in merged.values())
+        assert roles == ["follower", "follower", "single"]
+    finally:
+        ch.close()
+        for fs, svc in followers:
+            fs.stop(grace=1)
+            svc.close()
+        for st in stores:
+            st.close()
+        server.stop(grace=1)
+        ctx.shutdown()
+
+
+def test_query_overflow_fold_survives_liveness_filter():
+    """The "_overflow" aggregate renders in EVERY scope even when the
+    live-entity filter is active — bounded-cardinality traffic must
+    stay visible exactly when the cap engages."""
+    from hstream_tpu.stats.prometheus import render_holder
+
+    stats = StatsHolder()
+    stats.stat_add("emit_rows", "_overflow", 3.0)
+    stats.stat_add("append_in_bytes", "_overflow", 7.0)
+    text = render_holder(stats, live_streams=set(), live_queries=set())
+    assert 'hstream_emit_rows_rate{query="_overflow"}' in text
+    assert 'hstream_append_in_bytes_rate{stream="_overflow"}' in text
+
+
+def test_bare_follower_answers_cluster_stats(tmp_path):
+    """The StoreReplica face: a bare follower process (no HStreamApi)
+    still reports into the federation fan-out."""
+    from hstream_tpu.stats.cluster import _fetch_peer
+    from hstream_tpu.store import open_store
+    from hstream_tpu.store.replica import serve_follower
+
+    local = open_store("mem://")
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server, svc = serve_follower(local, f"127.0.0.1:{port}",
+                                 node_id="fed-follower")
+    try:
+        rep = _fetch_peer(f"127.0.0.1:{port}", timeout=5.0)
+        assert rep["node"] == "fed-follower"
+        assert rep["role"] == "follower"
+        assert rep["rss_bytes"] > 0
+        assert rep["streams"] == {}
+    finally:
+        server.stop(grace=1)
+        svc.close()
+        local.close()

@@ -81,19 +81,50 @@ def test_peek_rate_unregistered_raises_like_ts():
     assert stats.time_series_streams("append_in_bytes") == []
 
 
-def test_time_series_prune_drops_stale_buckets():
-    from hstream_tpu.stats import TimeSeries
+def test_time_series_fixed_rings_stay_bounded():
+    """The MultiLevelTimeSeries rings are fixed lists — adds move a
+    cursor, never grow a dict — and an idle gap wider than a ring
+    zeroes it instead of leaking stale buckets (exactness against
+    brute-force recounts lives in tests/test_cluster_stats.py)."""
+    from hstream_tpu.stats.timeseries import MultiLevelTimeSeries
 
-    ts = TimeSeries(max_window_s=5)
-    for i in range(30):
+    ts = MultiLevelTimeSeries()
+    for i in range(300):
         ts.add(1.0, now=1000.0 + i)
-    # prune fires past 2*max buckets and keeps only seconds within the
-    # window of the prune-time second: stale buckets are gone, the ring
-    # stays bounded
-    assert len(ts._buckets) <= 11
-    assert 1000 not in ts._buckets
-    assert min(ts._buckets) >= 1029 - 2 * 5
-    assert ts.rate(5, now=1029.0) == 1.0
+    assert [lv.n for lv in ts.levels] == [60, 60, 60]
+    # 1min level holds exactly the last 60 seconds' adds
+    assert ts.sum("1min", now=1299.0) == 60.0
+    assert ts.rate("1min", now=1299.0) == 1.0
+    # all-time never windows
+    assert ts.all_time() == (300.0, 300)
+    # an idle gap wider than the 1min ring drains it; wider levels
+    # still hold what their windows cover
+    assert ts.sum("1min", now=1299.0 + 120) == 0.0
+    assert ts.sum("10min", now=1299.0 + 120) > 0.0
+    with pytest.raises(KeyError):
+        ts.rate("2min")
+
+
+def test_stat_family_cardinality_bounded():
+    """A client looping over random stream names must not grow the
+    series map without bound: past TS_MAX_LABELS keys per family, new
+    keys fold into one overflow series (the histogram discipline)."""
+    from hstream_tpu.stats import TS_MAX_LABELS, TS_OVERFLOW_LABEL
+
+    stats = StatsHolder()
+    for i in range(TS_MAX_LABELS + 40):
+        stats.stat_add("append_in_bytes", f"junk-{i}", 10.0)
+    keys = stats.stat_keys("append_in_bytes")
+    assert len(keys) == TS_MAX_LABELS + 1
+    assert TS_OVERFLOW_LABEL in keys
+    lad = stats.stat_ladder("append_in_bytes", TS_OVERFLOW_LABEL)
+    assert lad["total"] == 400.0
+    # existing keys keep accumulating normally past the cap
+    stats.stat_add("append_in_bytes", "junk-0", 5.0)
+    assert stats.stat_ladder("append_in_bytes", "junk-0")["total"] == 15.0
+    # other families are unaffected by this family's fold
+    stats.stat_add("record_bytes", "fresh", 1.0)
+    assert stats.stat_keys("record_bytes") == ["fresh"]
 
 
 def test_unregistered_gauge_and_histogram_raise():
@@ -156,6 +187,14 @@ def _golden_holder() -> StatsHolder:
     stats.stream_stat_add("factory_recompiles", "step", 1)
     stats.stream_stat_add("device_h2d_bytes", "s1", 1024)
     stats.stream_stat_add("device_d2h_bytes", "s1", 512)
+    # rate ladders (ISSUE 15): adds stamped far in the past render a
+    # deterministic 0.0 in every trailing window — the golden checks
+    # the family/scope label plumbing and the stream_rate ladder
+    # layout, not wall-clock-dependent values
+    stats.stat_add("append_in_bytes", "s1", 4096.0, now=BASE / 1000)
+    stats.stat_add("append_in_records", "s1", 3.0, now=BASE / 1000)
+    stats.stat_add("delivered_records", "sub1", 7.0, now=BASE / 1000)
+    stats.stat_add("emit_rows", "q1", 5.0, now=BASE / 1000)
     stats.gauge_set("overload_level", "", 1)
     stats.gauge_set("running_queries", "", 2)
     stats.gauge_set("pipeline_occupancy", "q1", 0.5)

@@ -43,12 +43,25 @@ RULES = {
         "declared sets (tracing.TRACE_STAGES / KERNEL_FAMILIES) — a "
         "renamed stage silently orphans its histogram series and its "
         "spans"),
+    "registry-family": (
+        "stat_add/stat_rate/... call site names a stat family absent "
+        "from the declared table (stats/families.STAT_FAMILIES) — the "
+        "X-macro property: a family exists iff its table row does, so "
+        "an undeclared name would KeyError on a cold path and never "
+        "reach the admin/exposition/federation surfaces"),
 }
 
 COUNTER_CALLS = {"stream_stat_add", "stream_stat_get",
                  "stream_stat_getall"}
 TS_CALLS = {"time_series_add", "time_series_get_rate",
             "time_series_peek_rate", "time_series_streams", "_ts"}
+# the declarative-family API (ISSUE 15): same registry kind as the
+# legacy time-series shims (both resolve against STAT_FAMILIES), but
+# violations report under their own rule — the `.inc` discipline the
+# families table exists to enforce
+FAMILY_CALLS = {"stat_add", "stat_rate", "stat_sum", "stat_avg",
+                "stat_count", "stat_ladder", "stat_keys",
+                "_family_series", "_peek_series"}
 GAUGE_CALLS = {"gauge_set", "gauge_fn", "gauge_drop", "gauge_labels"}
 HIST_CALLS = {"observe", "histogram_percentile", "_hist"}
 
@@ -73,6 +86,8 @@ STAGE_LABELED_HISTOGRAMS = {"stage_latency_ms", "freshness_lag_ms"}
 _NO_REFERENCE_CREDIT = (
     "hstream_tpu/stats/__init__.py",
     "hstream_tpu/stats/events.py",
+    "hstream_tpu/stats/families.py",
+    "hstream_tpu/stats/timeseries.py",
     "hstream_tpu/stats/prometheus.py",
     "tools",
 )
@@ -89,13 +104,15 @@ def _registries(repo: str) -> dict[str, set[str]]:
         GAUGES,
         HISTOGRAMS,
         PER_STREAM_COUNTERS,
-        PER_STREAM_TIME_SERIES,
     )
     from hstream_tpu.stats.events import EVENT_KINDS
+    from hstream_tpu.stats.families import FAMILY_NAMES
 
     return {
         "counter": set(PER_STREAM_COUNTERS),
-        "time_series": {name for name, _ in PER_STREAM_TIME_SERIES},
+        # the declarative family table: the legacy time-series shims
+        # and the stat_* API both resolve against it
+        "time_series": set(FAMILY_NAMES),
         "gauge": set(GAUGES),
         "histogram": {name for name, _b, _l in HISTOGRAMS},
         "event": set(EVENT_KINDS),
@@ -111,6 +128,8 @@ _CALL_KIND: dict[str, str] = {}
 for _n in COUNTER_CALLS:
     _CALL_KIND[_n] = "counter"
 for _n in TS_CALLS:
+    _CALL_KIND[_n] = "time_series"
+for _n in FAMILY_CALLS:
     _CALL_KIND[_n] = "time_series"
 for _n in GAUGE_CALLS:
     _CALL_KIND[_n] = "gauge"
@@ -197,6 +216,12 @@ def run(files, repo) -> list[Finding]:
                 metric = first.value
                 if metric in registries[kind]:
                     referenced[kind].add(metric)
+                elif name in FAMILY_CALLS:
+                    out.append(Finding(
+                        "registry-family", src.rel, node.lineno,
+                        f"{name}({metric!r}, ...) names a stat "
+                        f"family absent from the declared table "
+                        f"(stats/families.STAT_FAMILIES)"))
                 else:
                     out.append(Finding(
                         "registry-unknown", src.rel, node.lineno,

@@ -103,6 +103,26 @@ NEW_MESSAGES = {
         ("applied_seq", 3, T.TYPE_UINT64),
         ("node_id", 4, T.TYPE_STRING),
     ],
+    # Cluster stats federation (ISSUE 15): every node folds its stats
+    # holder into one NodeStatsReport — structured scalars for the
+    # load axes the placer sorts on, plus the full per-stream rate
+    # ladders / per-query health as a JSON detail blob (the admin
+    # merge re-parses it; a schema per ladder level would freeze the
+    # family table into the wire format)
+    "ClusterStatsRequest": [],
+    "NodeStatsReport": [
+        ("node", 1, T.TYPE_STRING),
+        ("role", 2, T.TYPE_STRING),
+        ("ts_ms", 3, T.TYPE_INT64),
+        ("rss_bytes", 4, T.TYPE_UINT64),
+        ("running_queries", 5, T.TYPE_UINT32),
+        ("append_inflight", 6, T.TYPE_UINT64),
+        ("report", 7, T.TYPE_STRING),
+    ],
+    "ClusterStatsResponse": [
+        ("reports", 1, T.TYPE_MESSAGE, T.LABEL_REPEATED,
+         ".hstream.tpu.NodeStatsReport"),
+    ],
 }
 
 # service -> [(method, input msg, output msg[, client_streaming])]
@@ -115,9 +135,14 @@ NEW_METHODS = {
          "AppendColumnarResponse"),
         ("AppendColumnarStream", "AppendColumnarRequest",
          "AppendColumnarResponse", True),
+        # federation: a full server answers with its node load report
+        ("ClusterStats", "ClusterStatsRequest", "ClusterStatsResponse"),
     ],
     "StoreReplica": [
         ("Promote", "PromoteRequest", "PromoteResponse"),
+        # the same verb on the replica face, so a BARE follower
+        # process (no HStreamApi) still reports into the merged table
+        ("ClusterStats", "ClusterStatsRequest", "ClusterStatsResponse"),
     ],
 }
 
