@@ -1214,9 +1214,11 @@ def _smoke_tumbling_config():
     return ex, feed, 15
 
 
-def _smoke_join_config():
+def _smoke_join_config(mesh=None):
     """(executor, feed(b), warm_batches) for the device-join retrace
-    gate — shared by `--smoke` and the tier-1 RetraceGuard tests."""
+    gate — shared by `--smoke` and the tier-1 RetraceGuard tests. With
+    `mesh`, the join runs key-sharded (ISSUE 16) and the feed asserts
+    the sharded stores actually activated (no silent degrade)."""
     from hstream_tpu.sql.codegen import make_executor, stream_codegen
 
     plan = stream_codegen(
@@ -1225,7 +1227,7 @@ def _smoke_join_config():
         "GROUP BY l.k, TUMBLING (INTERVAL 2 SECOND) "
         "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
     ex = make_executor(plan, sample_rows=[{"k": "k0", "x": 1.0}],
-                       batch_capacity=4096)
+                       batch_capacity=4096, mesh=mesh)
     rng = np.random.default_rng(1)
     base = 1_700_000_000_000
     keys = np.array([f"k{i}" for i in range(500)], object)
@@ -1239,6 +1241,10 @@ def _smoke_join_config():
             base + b * 200 + ts_template,
             {"k": kcols[b % 4], "x": xcol},
             stream="l" if b % 2 else "r")
+        if mesh is not None and b == 5:
+            assert ex._dev is not None and \
+                ex._dev.get("sjl") is not None, \
+                f"join did not shard: {ex._device_refusal}"
 
     # warmup must reach the FIRST real eviction (stores half full at
     # ~32 batches) so the evict kernel's shape compiles before the
@@ -1246,9 +1252,11 @@ def _smoke_join_config():
     return ex, feed, 40
 
 
-def _smoke_session_config():
+def _smoke_session_config(mesh=None):
     """(executor, feed(b), warm_batches) for the device-session retrace
-    gate — shared by `--smoke` and the tier-1 RetraceGuard tests."""
+    gate — shared by `--smoke` and the tier-1 RetraceGuard tests. With
+    `mesh`, the session arena runs key-sharded (ISSUE 16) and the feed
+    asserts the sharded arena actually activated."""
     from hstream_tpu.engine import ColumnType, Schema
     from hstream_tpu.engine.expr import Col
     from hstream_tpu.engine.plan import AggKind, AggregateNode, AggSpec, \
@@ -1263,7 +1271,8 @@ def _smoke_session_config():
         aggs=[AggSpec(AggKind.COUNT_ALL, "c"),
               AggSpec(AggKind.APPROX_QUANTILE, "p50", input=Col("lat"),
                       quantile=0.5)])
-    ex = SessionExecutor(node, schema, emit_changes=False)
+    kw = {} if mesh is None else {"mesh": mesh}
+    ex = SessionExecutor(node, schema, emit_changes=False, **kw)
     ex.defer_close_decode = True
     rng = np.random.default_rng(2)
     base = 1_700_000_000_000
@@ -1281,6 +1290,10 @@ def _smoke_session_config():
                             {"user": kcols[b % 4], "lat": vcols[b % 4]})
         if b % 8 == 7:
             ex.drain_closed()  # stacked-drain shapes compile in warmup
+        if mesh is not None and b == 5:
+            assert ex._dev is not None and \
+                ex._dev.get("ssl") is not None, \
+                f"sessions did not shard: {ex._device_refusal}"
 
     # warmup spans activation, the first grow, close cycles, and every
     # stacked-drain depth the steady state uses
@@ -1415,12 +1428,91 @@ def _smoke_run(config, batches: int = 50) -> int:
     return g.count
 
 
+def _forced_device_env(n_devices: int) -> dict:
+    """A child env with the CPU backend pinned and EXACTLY n virtual
+    host devices — both must land before the child's first jax import
+    (the only moment XLA_FLAGS is read)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        .strip())
+    here = os.path.abspath(__file__)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [os.path.dirname(here),
+                    env.get("PYTHONPATH", "")] if p)
+    return env
+
+
+def _mesh_1xn(n_key: int):
+    """A (1, n_key) mesh: all shards on the key axis — the layout the
+    sharded join stores and session arenas split over."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    assert len(devs) >= n_key, f"{len(devs)} devices, need {n_key}"
+    return Mesh(np.asarray(devs[:n_key]).reshape(1, n_key),
+                ("data", "key"))
+
+
+def smoke_sharded_child_main() -> None:
+    """`python bench.py --smoke-sharded-child` (spawned by --smoke with
+    8 forced virtual devices): the sharded join + sharded session
+    retrace gate. Same contract as the single-chip gate — ZERO XLA
+    executables compiled over the steady-state batches; every shape
+    (sharded activation, fused probe+insert, arena step/merge, stacked
+    drains, evict) must be compiled during warmup."""
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n = jax.device_count()
+    assert n >= 8, f"child has {n} devices, need 8"
+    mesh = _mesh_1xn(8)
+    join = _smoke_run(lambda: _smoke_join_config(mesh=mesh))
+    session = _smoke_run(lambda: _smoke_session_config(mesh=mesh))
+    print(json.dumps({
+        "sharded_join_recompiles": join,
+        "sharded_session_recompiles": session,
+        "devices": n,
+    }))
+    sys.exit(1 if join or session else 0)
+
+
+def _smoke_sharded_subprocess() -> dict:
+    """Run the forced-8-device sharded retrace gate in a clean child
+    (the parent's jax is already initialized with the ambient device
+    count, so the virtual mesh must be provisioned pre-import)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, __file__, "--smoke-sharded-child"],
+        env=_forced_device_env(8), capture_output=True, text=True,
+        timeout=600)
+    sys.stderr.write(proc.stderr)
+    line = proc.stdout.strip().splitlines()
+    out = json.loads(line[-1]) if line else {}
+    out["rc"] = proc.returncode
+    return out
+
+
 def smoke_main() -> None:
     """`python bench.py --smoke`: the CI retrace gate (CPU backend) —
     a small fused-close run and a small device-join run must compile
     ZERO XLA executables in steady state. Exit 1 on any recompile, so
     a shape-key or factory-cache regression fails the tier-1 job in
-    seconds instead of surfacing as a silent 22x on real hardware."""
+    seconds instead of surfacing as a silent 22x on real hardware.
+    A forced-8-virtual-device child re-runs the join and session
+    configs SHARDED (ISSUE 16) under the same zero-recompile gate."""
     import os
     import sys
 
@@ -1441,23 +1533,32 @@ def smoke_main() -> None:
     join = _smoke_run(_smoke_join_config)
     session = _smoke_run(_smoke_session_config)
     server_columnar = _smoke_server_columnar()
+    sharded = _smoke_sharded_subprocess()
+    sharded_join = int(sharded.get("sharded_join_recompiles", -1))
+    sharded_session = int(sharded.get("sharded_session_recompiles", -1))
+    sharded_bad = (sharded.get("rc") != 0 or sharded_join != 0
+                   or sharded_session != 0)
     lock_edges = LOCKTRACE.edge_count()
     lock_state = len(LOCKTRACE.status()["locks"])
     result = {
         "metric": "recompiles_per_run",
         "mode": "smoke",
-        "value": tumbling + join + session + server_columnar,
+        "value": tumbling + join + session + server_columnar
+        + max(sharded_join, 0) + max(sharded_session, 0),
         "tumbling_recompiles": tumbling,
         "join_recompiles": join,
         "session_recompiles": session,
         "server_columnar_recompiles": server_columnar,
+        "sharded_join_recompiles": sharded_join,
+        "sharded_session_recompiles": sharded_session,
+        "sharded_devices": sharded.get("devices"),
         "locktrace_disarmed_edges": lock_edges,
         "locktrace_disarmed_locks": lock_state,
         "batches": 50,
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))
-    if tumbling or join or session or server_columnar:
+    if tumbling or join or session or server_columnar or sharded_bad:
         print("# retrace gate FAILED: steady-state batches compiled "
               "new XLA executables", flush=True)
         sys.exit(1)
@@ -1465,6 +1566,92 @@ def smoke_main() -> None:
         print("# locktrace gate FAILED: the DISARMED witness recorded "
               "state — the one-branch disarmed contract broke",
               flush=True)
+        sys.exit(1)
+
+
+def multichip_child_main(n_devices: int) -> None:
+    """`python bench.py --multichip-child N` (spawned by --multichip
+    with N forced virtual devices): run the sharded join and sharded
+    session dryrun configs and report eps + engine dispatches per
+    micro-batch — the kernel-contract number (one fused dispatch per
+    batch) the sharded paths must hold at every device count."""
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() >= n_devices
+    mesh = _mesh_1xn(n_devices) if n_devices > 1 else None
+    # rows per feed batch, fixed by the config builders
+    rows_per_batch = {"join": 256, "session": 512}
+    out = {"n_devices": n_devices}
+    for name, cfg in (("join", _smoke_join_config),
+                      ("session", _smoke_session_config)):
+        ex, feed, warm = cfg(mesh=mesh)
+        dispatches = [0]
+
+        def observe(_family, _seconds, _d=dispatches):
+            _d[0] += 1
+
+        ex.dispatch_observer = observe
+        for i in range(warm):
+            feed(i)
+        if hasattr(ex, "flush_changes"):
+            ex.flush_changes()
+        ex.block_until_ready()
+        dispatches[0] = 0
+        batches = 40
+        t0 = time.perf_counter()
+        for i in range(warm, warm + batches):
+            feed(i)
+        if hasattr(ex, "flush_changes"):
+            ex.flush_changes()
+        ex.block_until_ready()
+        dt = time.perf_counter() - t0
+        out[name] = {
+            "eps": round(batches * rows_per_batch[name] / dt, 1),
+            "dispatches_per_batch": round(dispatches[0] / batches, 3),
+            "sharded_dispatches": int(
+                getattr(ex, "sharded_dispatches", 0) or 0),
+        }
+        if mesh is not None:
+            assert out[name]["sharded_dispatches"] > 0, \
+                f"{name}: mesh set but no sharded dispatches ran"
+    print(json.dumps(out))
+
+
+def multichip_main() -> None:
+    """`python bench.py --multichip`: sharded join + sharded session
+    dryruns per device count (1 / 2 / 8 virtual CPU devices, each in a
+    clean child so the mesh is provisioned before jax import), eps and
+    dispatches-per-batch recorded into MULTICHIP_r06.json."""
+    import os
+    import subprocess
+    import sys
+
+    runs = []
+    ok = True
+    for n in (1, 2, 8):
+        proc = subprocess.run(
+            [sys.executable, __file__, "--multichip-child", str(n)],
+            env=_forced_device_env(n), capture_output=True, text=True,
+            timeout=900)
+        sys.stderr.write(proc.stderr)
+        lines = proc.stdout.strip().splitlines()
+        rec = json.loads(lines[-1]) if (proc.returncode == 0 and lines) \
+            else {"n_devices": n}
+        rec["rc"] = proc.returncode
+        ok = ok and proc.returncode == 0
+        runs.append(rec)
+    result = {"metric": "multichip_dryrun", "ok": ok, "runs": runs}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"metric": "multichip_dryrun", "ok": ok,
+                      "wrote": path}))
+    if not ok:
         sys.exit(1)
 
 
@@ -1498,6 +1685,13 @@ if __name__ == "__main__":
 
     if "--loopback" in sys.argv[1:]:
         loopback_main()
+    elif "--smoke-sharded-child" in sys.argv[1:]:
+        smoke_sharded_child_main()
+    elif "--multichip-child" in sys.argv[1:]:
+        idx = sys.argv.index("--multichip-child")
+        multichip_child_main(int(sys.argv[idx + 1]))
+    elif "--multichip" in sys.argv[1:]:
+        multichip_main()
     elif "--smoke" in sys.argv[1:]:
         smoke_main()
     else:

@@ -127,9 +127,16 @@ class _JoinBase:
     executor lifecycle."""
 
     def __init__(self, plan, *, initial_keys: int = 1024,
-                 batch_capacity: int = 4096):
+                 batch_capacity: int = 4096, mesh=None,
+                 data_axis: str = "data", key_axis: str = "key"):
         join = plan.join
         self.plan = plan
+        # mesh-sharded execution: a mesh whose key axis has >1 devices
+        # key-shards BOTH side stores (code % n_shards owns the entry)
+        # and the inner aggregate; without one the join runs single-chip
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.key_axis = key_axis
         self.left_name = plan.source
         self.right_name = join.right.name
         if self.right_name == self.left_name:
@@ -210,7 +217,8 @@ class _JoinBase:
             self._inner = make_executor(
                 self._inner_plan, sample_rows=joined,
                 initial_keys=self._initial_keys,
-                batch_capacity=self._batch_capacity)
+                batch_capacity=self._batch_capacity,
+                mesh=self.mesh)
             self._apply_inner_tuning()
         return self._inner.process(joined, jts)
 
@@ -429,11 +437,21 @@ class _FlatIntervalStore:
             self.comp = self.comp[keep]
             self.rows = self.rows[keep]
 
-    def remap_codes(self, new_of_old: np.ndarray) -> None:
-        """Apply a code compaction (sorted-order-preserving)."""
+    def remap_codes(self, new_of_old: np.ndarray,
+                    resort: bool = False) -> None:
+        """Apply a code compaction. A dense remap preserves sorted
+        order; a shard-class-preserving remap (sharded device mode)
+        does not, so ``resort`` re-sorts by the new composite."""
         self.code = new_of_old[self.code]
-        if self.t0 is not None:
-            self.comp = self.code * self.SPAN + (self.ts - self.t0)
+        if self.t0 is None:
+            return
+        self.comp = self.code * self.SPAN + (self.ts - self.t0)
+        if resort and len(self.comp):
+            order = np.argsort(self.comp, kind="stable")
+            self.code = self.code[order]
+            self.ts = self.ts[order]
+            self.comp = self.comp[order]
+            self.rows = self.rows[order]
 
     @property
     def by_key(self) -> dict:
@@ -463,9 +481,11 @@ class JoinExecutor(_JoinBase):
     supports_columnar_join = True
 
     def __init__(self, plan, *, initial_keys: int = 1024,
-                 batch_capacity: int = 4096):
+                 batch_capacity: int = 4096, mesh=None,
+                 data_axis: str = "data", key_axis: str = "key"):
         super().__init__(plan, initial_keys=initial_keys,
-                         batch_capacity=batch_capacity)
+                         batch_capacity=batch_capacity, mesh=mesh,
+                         data_axis=data_axis, key_axis=key_axis)
         join = plan.join
         self.within = join.within.ms
 
@@ -522,6 +542,17 @@ class JoinExecutor(_JoinBase):
         # this executor) to the retained host reference path; the query
         # task mirrors deltas into the device_path_fallbacks counter
         self.device_fallbacks = 0
+        # dispatches that ran under shard_map (probe/fused/evict); the
+        # query task mirrors deltas into the sharded_dispatches family
+        self._sharded_dispatches = 0
+
+    @property
+    def sharded_dispatches(self) -> int:
+        """Sharded device dispatches, join probe plane + the inner
+        aggregate's own (step/extract) — the per-query counter the
+        stats plane exposes."""
+        return self._sharded_dispatches + int(getattr(
+            self._inner, "sharded_dispatches", 0) or 0)
 
     # ---- ingest ------------------------------------------------------------
     #
@@ -696,13 +727,24 @@ class JoinExecutor(_JoinBase):
     # contract: dispatches<=0 fetches<=1
     def _compact_codes(self) -> None:
         """Code-space compaction: keep only codes still live in either
-        store (retention bounds them), reassign dense codes in sorted
-        order (store order is preserved), remap stores + lut + dict.
+        store (retention bounds them), reassign codes, remap stores +
+        shadows + lut + dict.
 
         Device mode fetches BOTH sides' code planes in one stacked
         transfer (they share cap): hstream-analyze's dispatch pass
         caught the original per-side fetch loop — two round trips on
-        the ingest path every time the code space filled."""
+        the ingest path every time the code space filled.
+
+        Single-chip remaps densely in sorted order (store order is
+        preserved). Sharded mode must keep every code's shard
+        residence, so the remap is residue-class-preserving (new =
+        rank-within-class * n_shards + class): per-shard device order
+        survives the gather remap, but GLOBAL (code, ts) order does
+        not — the host shadows re-sort, and the code space keeps holes
+        where the classes are unbalanced."""
+        from hstream_tpu.engine import lattice
+
+        sjl = self._dev.get("sjl") if self._dev is not None else None
         parts = [self._stores["l"].code, self._stores["r"].code]
         if self._dev is not None:
             self._refresh_counts()
@@ -712,27 +754,47 @@ class JoinExecutor(_JoinBase):
                 codes = np.asarray(jnp.stack(
                     [self._dev["stores"]["l"]["code"],
                      self._dev["stores"]["r"]["code"]]))
-                for i, s in enumerate(("l", "r")):
-                    n = self._dev["n"][s]
-                    if n:
-                        parts.append(codes[i, :n].astype(np.int64))
+                # eviction is lazy: dead-but-resident entries past the
+                # live prefix must stay mapped too, so take every
+                # non-sentinel slot (works for flat and sharded planes)
+                parts.append(np.unique(
+                    codes[codes < lattice.JOIN_SENT_CODE]
+                ).astype(np.int64))
         live = np.union1d(parts[0], np.concatenate(parts[1:])
                           if len(parts) > 1 else parts[0])
+        if sjl is not None:
+            cls = live % sjl.n_shards
+            new_codes = np.empty(len(live), np.int64)
+            for s in range(sjl.n_shards):
+                msk = cls == s
+                new_codes[msk] = (np.arange(int(msk.sum()),
+                                            dtype=np.int64)
+                                  * sjl.n_shards + s)
+        else:
+            new_codes = np.arange(len(live), dtype=np.int64)
         new_of_old = np.full(len(self._jcode_rev), -1, np.int64)
-        new_of_old[live] = np.arange(len(live))
+        new_of_old[live] = new_codes
+        resort = sjl is not None
         for st in self._stores.values():
-            st.remap_codes(new_of_old)
+            st.remap_codes(new_of_old, resort=resort)
         if self._dev is not None:
+            for st in self._dev["shadow"].values():
+                # the shadows size every match buffer: leaving them on
+                # the old code space would corrupt probe totals
+                st.remap_codes(new_of_old, resort=resort)
             self._remap_device_codes(new_of_old)
-        new_rev = [self._jcode_rev[int(c)] for c in live.tolist()]
+        new_rev: list = [None] * (int(new_codes.max()) + 1
+                                  if len(live) else 0)
+        for nc, oc in zip(new_codes.tolist(), live.tolist()):
+            new_rev[nc] = self._jcode_rev[oc]
         self._jcode.clear()
-        self._jcode.update({k: i for i, k in enumerate(new_rev)})
+        self._jcode.update({k: i for i, k in enumerate(new_rev)
+                            if k is not None})
         self._jcode_rev[:] = new_rev      # in place: stores share it
         lut = np.full(max(len(new_rev), 1024), -1, np.int32)
         old_lut = self._kid_lut
-        for new_c, old_c in enumerate(live.tolist()):
-            if old_c < len(old_lut):
-                lut[new_c] = old_lut[old_c]
+        inb = live < len(old_lut)
+        lut[new_codes[inb]] = old_lut[live[inb]]
         self._kid_lut = lut
 
     # ---- match emission ----------------------------------------------------
@@ -1046,9 +1108,22 @@ class JoinExecutor(_JoinBase):
         if self.watermark >= 0:
             cands.append(self.watermark)
         t0 = (min(cands) - self.retention_ms) if cands else None
+        sjl = None
+        if (self.mesh is not None
+                and self.key_axis in self.mesh.axis_names
+                and self.mesh.shape[self.key_axis] > 1):
+            from hstream_tpu.parallel.lattice import ShardedJoinLattice
+
+            # per-shard capacity keeps the single-chip formula: the
+            # worst key distribution lands every entry on one shard, so
+            # this trades memory (n_shards x) for never growing on skew
+            sjl = ShardedJoinLattice(
+                self.mesh, self.key_axis, cap, 1024, 4096,
+                len(lay["l"]), len(lay["r"]))
         self._dev = {
             "lay": lay,
             "cap": cap,
+            "sjl": sjl,
             "t0": t0,
             "n": {"l": 0, "r": 0},
             # match buffers start small and stick at the pow2 the
@@ -1060,8 +1135,10 @@ class JoinExecutor(_JoinBase):
             "bcaps": set(),
             "evict_cutoff": -(1 << 62),
             "stores": {
-                "l": lattice.init_join_store(cap, len(lay["l"])),
-                "r": lattice.init_join_store(cap, len(lay["r"])),
+                "l": (sjl.init_store("l") if sjl is not None
+                      else lattice.init_join_store(cap, len(lay["l"]))),
+                "r": (sjl.init_store("r") if sjl is not None
+                      else lattice.init_join_store(cap, len(lay["r"]))),
             },
             # host shadow of each side's (code, ts) multiset, pruned at
             # the probe cutoff: gives EXACT match totals before every
@@ -1093,6 +1170,11 @@ class JoinExecutor(_JoinBase):
         inner = self._inner
         if (getattr(inner, "spec", None) is None
                 or not hasattr(inner, "_null_specs")):
+            return None
+        if ((self._dev.get("sjl") is not None)
+                != (getattr(inner, "_sharded", None) is not None)):
+            # a sharded join can only fuse into a sharded inner lattice
+            # (and vice versa); a mismatch keeps the match-fetch path
             return None
         lay_idx = {s: {name: j for j, (name, _c)
                        in enumerate(self._dev["lay"][s])}
@@ -1155,6 +1237,28 @@ class JoinExecutor(_JoinBase):
         from hstream_tpu.engine import lattice
 
         cap = dev["cap"]
+        sjl = dev.get("sjl")
+        if sjl is not None:
+            # distribute entries into their owning shard's slice; each
+            # residue class of a (code, ts)-sorted sequence is itself
+            # (code, ts)-sorted, so per-shard order needs no re-sort
+            ns = sjl.n_shards
+            scode = np.full((ns, cap), lattice.JOIN_SENT_CODE, np.int32)
+            sts = np.zeros((ns, cap), np.int32)
+            sfl = np.zeros((ns, cap), np.int32)
+            scv = np.zeros((ns, len(lay), cap), np.int32)
+            cls = (st.code % ns).astype(np.int64)
+            for s in range(ns):
+                m = np.nonzero(cls == s)[0]
+                k = len(m)
+                scode[s, :k] = st.code[m].astype(np.int32)
+                sts[s, :k] = (st.ts[m] - dev["t0"]).astype(np.int32)
+                sfl[s, :k] = flags[m]
+                scv[s, :, :k] = vals[:, m]
+            dev["stores"][side] = sjl.put_store(
+                {"code": scode, "ts": sts, "flags": sfl, "cols": scv})
+            dev["n"][side] = n
+            return
         code = np.full(cap, lattice.JOIN_SENT_CODE, np.int32)
         code[:n] = st.code.astype(np.int32)
         ts = np.zeros(cap, np.int32)
@@ -1460,7 +1564,18 @@ class JoinExecutor(_JoinBase):
         if cutoff_abs is not None:
             lo_ts = np.maximum(lo_ts, cutoff_abs)
         pr = shadow_o.probe(codes, lo_ts, bts + self.within)
-        total = int((pr[1] - pr[0]).sum()) if pr is not None else 0
+        sjl = dev.get("sjl")
+        if pr is None:
+            total = 0
+        elif sjl is not None:
+            # the match buffer is PER SHARD: size it to the worst
+            # shard's total (each shard packs its own segment)
+            per = np.bincount((codes % sjl.n_shards).astype(np.int64),
+                              weights=(pr[1] - pr[0]).astype(np.float64),
+                              minlength=sjl.n_shards)
+            total = int(per.max())
+        else:
+            total = int((pr[1] - pr[0]).sum())
         dev["shadow"][side].insert_sorted(codes, bts,
                                           np.empty(n, object))
         if cutoff_abs is not None and cutoff_abs > 0:
@@ -1492,13 +1607,21 @@ class JoinExecutor(_JoinBase):
         self.join_stats["probe_dispatches"] += 1
         if dev.get("feed") is not None and self._fuse_ok(bts):
             return self._fused_batch(side, other_side, buf, n, cutoff)
-        kern = lattice.join_probe_insert(
-            dev["cap"], bcap, dev["match_cap"], len(lay),
-            len(dev["lay"][other_side]))
-        with kernel_family("probe", self.dispatch_observer):
-            dev["stores"][side], packed = kern(
-                dev["stores"][side], other, buf, np.int32(n),
-                np.int32(self.within), cutoff)
+        if sjl is not None:
+            with kernel_family("probe", self.dispatch_observer):
+                dev["stores"][side], packed = sjl.probe_insert(
+                    side, dev["stores"][side], other, buf, np.int32(n),
+                    np.int32(self.within), cutoff,
+                    match_cap=dev["match_cap"])
+            self._sharded_dispatches += 1
+        else:
+            kern = lattice.join_probe_insert(
+                dev["cap"], bcap, dev["match_cap"], len(lay),
+                len(dev["lay"][other_side]))
+            with kernel_family("probe", self.dispatch_observer):
+                dev["stores"][side], packed = kern(
+                    dev["stores"][side], other, buf, np.int32(n),
+                    np.int32(self.within), cutoff)
         self._note_insert(side, n)
         # the pending entry keeps (batch, other-store ref) alive so a
         # truncated match buffer could re-probe wider (unreachable
@@ -1572,16 +1695,30 @@ class JoinExecutor(_JoinBase):
                           if inner.watermark_abs >= 0 else -1)
         ts_off = np.int32(dev["t0"] - inner.epoch)
         feed, nulls_plan, filter_nulls = dev["feed"][side]
-        kern = lattice.join_probe_insert_step(
-            dev["cap"], buf.shape[1], dev["match_cap"],
-            len(dev["lay"][side]), len(dev["lay"][other_side]),
-            inner.spec, inner.schema, inner._filter_expr, feed,
-            nulls_plan, filter_nulls)
-        with kernel_family("probe", self.dispatch_observer):
-            dev["stores"][side], inner.state, _total = kern(
-                dev["stores"][side], dev["stores"][other_side], buf,
-                np.int32(n), np.int32(self.within), cutoff, inner.state,
-                wm_rel, ts_off)
+        sjl = dev.get("sjl")
+        if sjl is not None:
+            with kernel_family("probe", self.dispatch_observer):
+                dev["stores"][side], inner.state, _total = \
+                    sjl.probe_insert_step(
+                        side, inner._sharded, dev["stores"][side],
+                        dev["stores"][other_side], buf, np.int32(n),
+                        np.int32(self.within), cutoff, inner.state,
+                        wm_rel, ts_off, feed_plan=feed,
+                        nulls_plan=nulls_plan,
+                        filter_nulls=filter_nulls,
+                        match_cap=dev["match_cap"])
+            self._sharded_dispatches += 1
+        else:
+            kern = lattice.join_probe_insert_step(
+                dev["cap"], buf.shape[1], dev["match_cap"],
+                len(dev["lay"][side]), len(dev["lay"][other_side]),
+                inner.spec, inner.schema, inner._filter_expr, feed,
+                nulls_plan, filter_nulls)
+            with kernel_family("probe", self.dispatch_observer):
+                dev["stores"][side], inner.state, _total = kern(
+                    dev["stores"][side], dev["stores"][other_side], buf,
+                    np.int32(n), np.int32(self.within), cutoff,
+                    inner.state, wm_rel, ts_off)
         self._note_insert(side, n)
         self.join_stats["fused_batches"] += 1
         # inner host bookkeeping over the conservative ts range (the
@@ -1640,10 +1777,20 @@ class JoinExecutor(_JoinBase):
         from hstream_tpu.common.columnar import extend_rows
 
         out = None
+        sjl = self._dev.get("sjl")
         for hbuf, side, t0, buf, n, other, cutoff in host:
             nm = len(self._dev["lay"][side])
-            total = int(hbuf[0, 0])
-            if total > hbuf.shape[1]:
+            if sjl is not None:
+                # per-shard headers sit at column s * match_cap; any
+                # shard's truncation forces the whole-buffer redo
+                mc = hbuf.shape[1] // sjl.n_shards
+                total = max(int(hbuf[0, s * mc])
+                            for s in range(sjl.n_shards))
+                width = mc
+            else:
+                total = int(hbuf[0, 0])
+                width = hbuf.shape[1]
+            if total > width:
                 hbuf = self._reprobe_wider(side, buf, n, other, cutoff,
                                            total)
             out = extend_rows(out, self._decode_matches(side, t0, hbuf,
@@ -1662,11 +1809,17 @@ class JoinExecutor(_JoinBase):
         match_cap = round_up_pow2(total, lo=dev["match_cap"] * 2)
         dev["match_cap"] = max(dev["match_cap"], match_cap)
         other_side = "r" if side == "l" else "l"
+        self.join_stats["match_redispatches"] += 1
+        self.join_stats["probe_fetches"] += 1
+        sjl = dev.get("sjl")
+        if sjl is not None:
+            self._sharded_dispatches += 1
+            return np.asarray(sjl.probe_only(
+                side, other, buf, np.int32(n), np.int32(self.within),
+                cutoff, match_cap))
         kern = lattice.join_probe_only(
             other["code"].shape[0], buf.shape[1], match_cap,
             len(dev["lay"][side]), len(dev["lay"][other_side]))
-        self.join_stats["match_redispatches"] += 1
-        self.join_stats["probe_fetches"] += 1
         return np.asarray(kern(other, buf, np.int32(n),
                                np.int32(self.within), cutoff))
 
@@ -1679,8 +1832,13 @@ class JoinExecutor(_JoinBase):
         from hstream_tpu.engine import lattice
         from hstream_tpu.engine.types import ColumnType
 
-        total, kid, jts, mflags, oflags, mcols, ocols = \
-            lattice.unpack_join_matches(hbuf, nm)
+        sjl = self._dev.get("sjl") if self._dev is not None else None
+        if sjl is not None:
+            total, kid, jts, mflags, oflags, mcols, ocols = \
+                sjl.unpack_matches(hbuf, side)
+        else:
+            total, kid, jts, mflags, oflags, mcols, ocols = \
+                lattice.unpack_join_matches(hbuf, nm)
         m = len(kid)
         if m == 0:
             return []
@@ -1780,11 +1938,20 @@ class JoinExecutor(_JoinBase):
 
         dev = self._dev
         cutoff_rel = max(cutoff_abs - dev["t0"], 0)
-        kern = lattice.join_evict(dev["cap"], len(dev["lay"]["l"]),
-                                  len(dev["lay"]["r"]))
-        left, right, narr = kern(dev["stores"]["l"], dev["stores"]["r"],
-                                 np.int32(min(cutoff_rel, (1 << 31) - 1)),
-                                 np.int32(delta))
+        sjl = dev.get("sjl")
+        if sjl is not None:
+            left, right, narr = sjl.evict(
+                dev["stores"]["l"], dev["stores"]["r"],
+                np.int32(min(cutoff_rel, (1 << 31) - 1)),
+                np.int32(delta))
+            self._sharded_dispatches += 1
+        else:
+            kern = lattice.join_evict(dev["cap"], len(dev["lay"]["l"]),
+                                      len(dev["lay"]["r"]))
+            left, right, narr = kern(
+                dev["stores"]["l"], dev["stores"]["r"],
+                np.int32(min(cutoff_rel, (1 << 31) - 1)),
+                np.int32(delta))
         dev["stores"]["l"] = left
         dev["stores"]["r"] = right
         # the deferred count snapshot reflects the store AT THIS
@@ -1815,6 +1982,8 @@ class JoinExecutor(_JoinBase):
         if pend is not None:
             narr, since = pend
             n = np.asarray(narr)
+            if n.ndim == 2:       # sharded evict: per-shard [ns, 2]
+                n = n.sum(axis=0)
             dev["n"] = {"l": int(n[0]) + since["l"],
                         "r": int(n[1]) + since["r"]}
 
@@ -1827,16 +1996,33 @@ class JoinExecutor(_JoinBase):
 
         dev = self._dev
         extra = new_cap - dev["cap"]
+        sjl = dev.get("sjl")
         for s in ("l", "r"):
             st = dev["stores"][s]
-            dev["stores"][s] = {
-                "code": jnp.pad(st["code"], (0, extra),
-                                constant_values=lattice.JOIN_SENT_CODE),
-                "ts": jnp.pad(st["ts"], (0, extra)),
-                "flags": jnp.pad(st["flags"], (0, extra)),
-                "cols": jnp.pad(st["cols"], ((0, 0), (0, extra))),
-            }
+            if sjl is not None:
+                # per-shard slot axis is axis 1 (leading axis is the
+                # shard); re-put to keep the key-axis sharding
+                dev["stores"][s] = sjl.put_store({
+                    "code": jnp.pad(
+                        st["code"], ((0, 0), (0, extra)),
+                        constant_values=lattice.JOIN_SENT_CODE),
+                    "ts": jnp.pad(st["ts"], ((0, 0), (0, extra))),
+                    "flags": jnp.pad(st["flags"], ((0, 0), (0, extra))),
+                    "cols": jnp.pad(st["cols"],
+                                    ((0, 0), (0, 0), (0, extra))),
+                })
+            else:
+                dev["stores"][s] = {
+                    "code": jnp.pad(
+                        st["code"], (0, extra),
+                        constant_values=lattice.JOIN_SENT_CODE),
+                    "ts": jnp.pad(st["ts"], (0, extra)),
+                    "flags": jnp.pad(st["flags"], (0, extra)),
+                    "cols": jnp.pad(st["cols"], ((0, 0), (0, extra))),
+                }
         dev["cap"] = new_cap
+        if sjl is not None:
+            sjl.cap = new_cap
         self.join_stats["store_grows"] += 1
 
     def _remap_device_codes(self, new_of_old: np.ndarray) -> None:
@@ -1894,6 +2080,27 @@ class JoinExecutor(_JoinBase):
                 # analyze: ok dispatch-sync — rare, host-driven
                 arrs = {k: np.asarray(v) for k, v in jax.device_get(
                     self._dev["stores"][side]).items()}
+                if self._dev.get("sjl") is not None:
+                    # flatten the per-shard planes into one globally
+                    # (code, ts)-sorted sequence: live entries are each
+                    # shard's non-sentinel slots, but shards interleave
+                    # in global code order
+                    from hstream_tpu.engine import lattice as _lat
+
+                    shard, slot = np.nonzero(
+                        arrs["code"] < _lat.JOIN_SENT_CODE)
+                    fcols = arrs["cols"].transpose(1, 0, 2)[
+                        :, shard, slot]
+                    fcode = arrs["code"][shard, slot]
+                    fts = arrs["ts"][shard, slot]
+                    order = np.lexsort((fts, fcode))
+                    arrs = {
+                        "code": fcode[order],
+                        "ts": fts[order],
+                        "flags": arrs["flags"][shard, slot][order],
+                        "cols": fcols[:, order],
+                    }
+                    n = len(order)
                 if cutoff is not None:
                     keep = (arrs["ts"][:n].astype(np.int64)
                             + self._dev["t0"]) >= cutoff

@@ -1013,6 +1013,72 @@ def join_probe_only(cap: int, bcap: int, match_cap: int,
     return probe
 
 
+def _join_match_feed(other, batch, n, within, cutoff, bcap: int,
+                     match_cap: int, feed_plan, nulls_plan,
+                     filter_nulls, owned=None):
+    """Probe + inner-feed core shared by the fused single-chip kernel
+    and the key-sharded mirror (parallel.ShardedJoinLattice): expand
+    the match spans and resolve every inner-step column straight from
+    the match sources. Returns (total, kid, jts_rel, valid, cols) —
+    `cols` includes the __null_a{i} masks, `valid` has filter-NULL
+    records already masked out. `owned` (bool[bcap] or None) restricts
+    which batch records this shard probes."""
+    total, rec, oidx, mvalid, jts = _join_match_arrays(
+        other, batch, n, within, cutoff, bcap, match_cap, owned)
+    mflags = batch[3][rec]
+    oflags = other["flags"][oidx]
+
+    def lpres_of(src, jm, jo):
+        # which physical side is the SQL left side: "both" = the
+        # probing batch, "both_o" = the probed store
+        if src == "both":
+            return ((mflags >> (2 * jm + 1)) & 1) != 0
+        return ((oflags >> (2 * jo + 1)) & 1) != 0
+
+    def null_bit(src, jm, jo):
+        mnull = (((mflags >> (2 * jm)) & 1) != 0 if jm >= 0
+                 else None)
+        onull = (((oflags >> (2 * jo)) & 1) != 0 if jo >= 0
+                 else None)
+        if src == "m":
+            return mnull
+        if src == "o":
+            return onull
+        left, right = ((mnull, onull) if src == "both"
+                       else (onull, mnull))
+        return jnp.where(lpres_of(src, jm, jo), left, right)
+
+    def raw_val(src, jm, jo):
+        mv = batch[4 + jm][rec] if jm >= 0 else 0
+        ov = other["cols"][jo][oidx] if jo >= 0 else 0
+        if src == "m":
+            return mv
+        if src == "o":
+            return ov
+        left, right = (mv, ov) if src == "both" else (ov, mv)
+        return jnp.where(lpres_of(src, jm, jo), left, right)
+
+    cols = {}
+    for name, tag, src, jm, jo in feed_plan:
+        raw = raw_val(src, jm, jo)
+        if tag == "f32":
+            cols[name] = jax.lax.bitcast_convert_type(raw, jnp.float32)
+        elif tag == "bool":
+            cols[name] = raw != 0
+        else:
+            cols[name] = raw
+    for null_key, refs in nulls_plan:
+        m = jnp.zeros((match_cap,), jnp.bool_)
+        for src, jm, jo in refs:
+            m = m | null_bit(src, jm, jo)
+        cols[null_key] = m
+    valid = mvalid
+    for src, jm, jo in filter_nulls:
+        valid = valid & ~null_bit(src, jm, jo)
+    kid = jnp.where(mvalid, batch[2][rec], 0)
+    return total, kid, jts, valid, cols
+
+
 @functools.lru_cache(maxsize=256)
 def join_probe_insert_step(cap: int, bcap: int, match_cap: int,
                            n_cols_mine: int, n_cols_other: int,
@@ -1050,61 +1116,10 @@ def join_probe_insert_step(cap: int, bcap: int, match_cap: int,
     @jax.jit
     def probe_insert_step(mine, other, batch, n, within, cutoff,
                           inner_state, wm_rel, ts_off):
-        total, rec, oidx, mvalid, jts = _join_match_arrays(
-            other, batch, n, within, cutoff, bcap, match_cap)
-        mflags = batch[3][rec]
-        oflags = other["flags"][oidx]
-
-        def lpres_of(src, jm, jo):
-            # which physical side is the SQL left side: "both" = the
-            # probing batch, "both_o" = the probed store
-            if src == "both":
-                return ((mflags >> (2 * jm + 1)) & 1) != 0
-            return ((oflags >> (2 * jo + 1)) & 1) != 0
-
-        def null_bit(src, jm, jo):
-            mnull = (((mflags >> (2 * jm)) & 1) != 0 if jm >= 0
-                     else None)
-            onull = (((oflags >> (2 * jo)) & 1) != 0 if jo >= 0
-                     else None)
-            if src == "m":
-                return mnull
-            if src == "o":
-                return onull
-            left, right = ((mnull, onull) if src == "both"
-                           else (onull, mnull))
-            return jnp.where(lpres_of(src, jm, jo), left, right)
-
-        def raw_val(src, jm, jo):
-            mv = batch[4 + jm][rec] if jm >= 0 else 0
-            ov = other["cols"][jo][oidx] if jo >= 0 else 0
-            if src == "m":
-                return mv
-            if src == "o":
-                return ov
-            left, right = (mv, ov) if src == "both" else (ov, mv)
-            return jnp.where(lpres_of(src, jm, jo), left, right)
-
-        cols = {}
-        for name, tag, src, jm, jo in feed_plan:
-            raw = raw_val(src, jm, jo)
-            if tag == "f32":
-                cols[name] = jax.lax.bitcast_convert_type(raw,
-                                                          jnp.float32)
-            elif tag == "bool":
-                cols[name] = raw != 0
-            else:
-                cols[name] = raw
-        for null_key, refs in nulls_plan:
-            m = jnp.zeros((match_cap,), jnp.bool_)
-            for src, jm, jo in refs:
-                m = m | null_bit(src, jm, jo)
-            cols[null_key] = m
-        valid = mvalid
-        for src, jm, jo in filter_nulls:
-            valid = valid & ~null_bit(src, jm, jo)
+        total, kid, jts, valid, cols = _join_match_feed(
+            other, batch, n, within, cutoff, bcap, match_cap,
+            feed_plan, nulls_plan, filter_nulls)
         ts_inner = jts + ts_off
-        kid = jnp.where(mvalid, batch[2][rec], 0)
         new_inner = base_step(inner_state, wm_rel, kid, ts_inner,
                               valid, cols)
         new_mine = _join_insert(mine, batch, n, bcap, n_cols_mine)

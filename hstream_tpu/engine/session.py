@@ -272,7 +272,9 @@ class SessionExecutor:
     def __init__(self, node: AggregateNode, schema: Schema, *,
                  emit_changes: bool = False,
                  hll: HLLConfig = HLLConfig(),
-                 qcfg: QuantileConfig = QuantileConfig()):
+                 qcfg: QuantileConfig = QuantileConfig(),
+                 mesh=None, data_axis: str = "data",
+                 key_axis: str = "key"):
         if not isinstance(node.window, SessionWindow):
             raise SQLCodegenError("SessionExecutor needs a SessionWindow")
         self.node = node
@@ -300,6 +302,14 @@ class SessionExecutor:
         self.use_device_sessions = True
         self._dev: dict | None = None
         self._device_refusal: str | None = None   # host-only config
+        # a mesh whose key axis has >1 devices key-shards the session
+        # arena (ShardedSessionLattice: chain merge is key-local, so
+        # every device op stays embarrassingly per-shard); single-device
+        # meshes keep the single-chip kernels
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.key_axis = key_axis
+        self.sharded_dispatches = 0
         # None = auto (backend-dependent); "record" | "segment" force a
         # kernel mode — see _plan_device
         self.device_session_mode: str | None = None
@@ -1041,29 +1051,60 @@ class SessionExecutor:
         mir_code, mir_t0, mir_t1 = (mir_code[order], mir_t0[order],
                                     mir_t1[order])
         epoch = int(mir_t0.min()) if n else None
+        ssl = None
+        if (self.mesh is not None
+                and self.key_axis in self.mesh.axis_names
+                and self.mesh.shape[self.key_axis] > 1):
+            from hstream_tpu.parallel.lattice import \
+                ShardedSessionLattice
+            ssl = ShardedSessionLattice(self.mesh, self.key_axis, spec,
+                                        self.schema, plan["layout"])
         arena_np = lattice.session_plane_np(spec, cap)
+        if ssl is not None:
+            # per-shard planes: each key shard holds its residue class
+            # (code % n_shards) in mirror order. The per-shard cap keeps
+            # the single-chip formula — memory spent on skew tolerance.
+            arena_np = {k: np.broadcast_to(
+                v[None], (ssl.n_shards,) + v.shape).copy()
+                for k, v in arena_np.items()}
+            cls = (mir_code % ssl.n_shards).astype(np.int64)
+            sl = np.empty(n, np.int64)
+            for s in range(ssl.n_shards):
+                m = cls == s
+                sl[m] = np.arange(int(m.sum()))
+
+        def dst(j):
+            return (cls[j], sl[j]) if ssl is not None else j
+
         if n:
-            arena_np["code"][:n] = mir_code.astype(np.int32)
-            arena_np["t0"][:n] = (mir_t0 - epoch).astype(np.int32)
-            arena_np["t1"][:n] = (mir_t1 - epoch).astype(np.int32)
+            if ssl is not None:
+                arena_np["code"][cls, sl] = mir_code.astype(np.int32)
+                arena_np["t0"][cls, sl] = (mir_t0 - epoch).astype(
+                    np.int32)
+                arena_np["t1"][cls, sl] = (mir_t1 - epoch).astype(
+                    np.int32)
+            else:
+                arena_np["code"][:n] = mir_code.astype(np.int32)
+                arena_np["t0"][:n] = (mir_t0 - epoch).astype(np.int32)
+                arena_np["t1"][:n] = (mir_t1 - epoch).astype(np.int32)
             for name, a in zip(lattice.session_plane_names(spec),
                                spec.aggs):
                 for j, (_code, s) in enumerate(
                         (entries[o] for o in order.tolist())):
                     acc = s.accs[a.out_name]
                     if a.kind == AggKind.AVG:
-                        arena_np[name][j] = np.float32(acc[0])
-                        arena_np[name + "_n"][j] = acc[1]
+                        arena_np[name][dst(j)] = np.float32(acc[0])
+                        arena_np[name + "_n"][dst(j)] = acc[1]
                     elif a.kind == AggKind.APPROX_COUNT_DISTINCT:
-                        arena_np[name][j] = acc
+                        arena_np[name][dst(j)] = acc
                     elif a.kind == AggKind.APPROX_QUANTILE:
                         if int(np.max(acc, initial=0)) >= (1 << 31):
                             raise SQLCodegenError(
                                 "session histogram count exceeds int32 "
                                 "at device activation")
-                        arena_np[name][j] = acc.astype(np.int32)
+                        arena_np[name][dst(j)] = acc.astype(np.int32)
                     else:
-                        arena_np[name][j] = np.float32(acc) \
+                        arena_np[name][dst(j)] = np.float32(acc) \
                             if arena_np[name].dtype == np.float32 else acc
         self._dev = {
             "spec": spec,
@@ -1071,7 +1112,10 @@ class SessionExecutor:
             "null_refs": plan["null_refs"],
             "mode": plan["mode"],
             "cap": cap,
-            "arena": {k: jax.device_put(v) for k, v in arena_np.items()},
+            "ssl": ssl,
+            "arena": (ssl.put_arena(arena_np) if ssl is not None else
+                      {k: jax.device_put(v)
+                       for k, v in arena_np.items()}),
             "mir_code": mir_code,
             "mir_t0": mir_t0,
             "mir_t1": mir_t1,
@@ -1112,6 +1156,12 @@ class SessionExecutor:
 
         dev = self._dev
         host = jax.device_get(dev["arena"])
+        if dev.get("ssl") is not None:
+            # gather every mirror row's value out of its shard's plane:
+            # the flattened view indexes by mirror row, exactly like the
+            # single-chip arena below
+            cls, sl = self._shard_slots()
+            host = {k: v[cls, sl] for k, v in host.items()}
         spec = dev["spec"]
         sessions: dict[tuple, list[_Session]] = {}
         from hstream_tpu.engine import lattice
@@ -1139,6 +1189,21 @@ class SessionExecutor:
                 start=int(dev["mir_t0"][slot]),
                 end=int(dev["mir_t1"][slot]), accs=accs))
         return sessions
+
+    def _shard_slots(self) -> tuple[np.ndarray, np.ndarray]:
+        """(key shard, per-shard arena slot) of every mirror row: each
+        shard's arena holds exactly its residue class's chains in mirror
+        order, so a row's slot is its rank within its class. Dead rows
+        (mir_live False) still occupy arena slots until the next step
+        dispatch retires them, so the ranks run over ALL rows."""
+        dev = self._dev
+        ns = dev["ssl"].n_shards
+        cls = (dev["mir_code"] % ns).astype(np.int64)
+        slot = np.empty(len(cls), np.int64)
+        for s in range(ns):
+            m = cls == s
+            slot[m] = np.arange(int(m.sum()))
+        return cls, slot
 
     def _process_rows_device(self, rows, ts_ms):
         """Row-shaped ingest onto the device path: host filter eval,
@@ -1546,8 +1611,14 @@ class SessionExecutor:
                     f"one session chain merged {fanin} open sessions "
                     f"(> chain_merge_limit {self.chain_merge_limit})")
                 return _DEGRADED
-            if len(mcode) > dev["cap"]:
-                self._grow_arena(len(mcode))
+            need = len(mcode)
+            if dev.get("ssl") is not None:
+                # per-shard cap: size to the fullest residue class
+                need = int(np.bincount(
+                    (mcode % dev["ssl"].n_shards).astype(np.int64),
+                    minlength=dev["ssl"].n_shards).max())
+            if need > dev["cap"]:
+                self._grow_arena(need)
             if self.epoch is None:
                 self.epoch = int(mt0.min())
             # close_cut is compared against PRE-shift arena times in the
@@ -1632,6 +1703,15 @@ class SessionExecutor:
             null_masks, dev["layout"])
         self.transfer_stats["h2d_bytes"] += int(
             getattr(packed, "nbytes", 0))
+        ssl = dev.get("ssl")
+        if ssl is not None:
+            # the batch replicates along the key axis; the shard_map
+            # wrapper clears the valid bit of records other shards own
+            self.sharded_dispatches += 1
+            with kernel_family("session", self.dispatch_observer):
+                return ssl.step(dev["arena"], packed,
+                                np.int32(self.window.gap_ms), close_cut,
+                                np.int32(delta))
         step = lattice.session_step_kernel(
             dev["spec"], self.schema, dev["layout"], dev["cap"], bcap)
         with kernel_family("session", self.dispatch_observer):
@@ -1656,6 +1736,15 @@ class SessionExecutor:
                                    seg_t1 - self.epoch)
         self.transfer_stats["h2d_bytes"] += sum(
             int(getattr(v, "nbytes", 0)) for v in seg.values())
+        ssl = dev.get("ssl")
+        if ssl is not None:
+            # segments replicate along the key axis; the shard_map
+            # wrapper rewrites unowned segment codes to the sentinel
+            self.sharded_dispatches += 1
+            with kernel_family("session", self.dispatch_observer):
+                return ssl.merge(dev["arena"], seg,
+                                 np.int32(self.window.gap_ms), close_cut,
+                                 np.int32(delta))
         kern = lattice.session_merge_kernel(dev["spec"], dev["cap"],
                                             len(seg["code"]))
         with kernel_family("session", self.dispatch_observer):
@@ -1829,8 +1918,11 @@ class SessionExecutor:
 
         dev = self._dev
         new_cap = round_up_pow2(need, lo=dev["cap"] * 2)
-        dev["arena"] = lattice.grow_session_arena(
-            dev["spec"], dev["arena"], new_cap)
+        if dev.get("ssl") is not None:
+            dev["arena"] = dev["ssl"].grow_arena(dev["arena"], new_cap)
+        else:
+            dev["arena"] = lattice.grow_session_arena(
+                dev["spec"], dev["arena"], new_cap)
         dev["cap"] = new_cap
         self.session_stats["grows"] += 1
 
@@ -1859,10 +1951,29 @@ class SessionExecutor:
         live_codes = np.unique(dev["mir_code"][live]).astype(np.int64)
         lcap = round_up_pow2(max(len(self._code_rev), 1), lo=256)
         lut = np.full(lcap, lattice.SESSION_SENT_CODE, np.int32)
-        lut[live_codes] = np.arange(len(live_codes), dtype=np.int32)
-        kern = lattice.session_remap_kernel(dev["cap"], lcap)
+        ssl = dev.get("ssl")
+        if ssl is not None:
+            # residue-class-preserving compaction (new % n_shards ==
+            # old % n_shards): entries never change owner shard, and
+            # within a shard the map is order-preserving, so every
+            # per-shard arena stays (code, t0)-sorted without a sort
+            ns = ssl.n_shards
+            new_of = np.empty(len(live_codes), np.int64)
+            for s in range(ns):
+                m = (live_codes % ns) == s
+                new_of[m] = np.arange(int(m.sum()),
+                                      dtype=np.int64) * ns + s
+        else:
+            new_of = np.arange(len(live_codes), dtype=np.int64)
+        lut[live_codes] = new_of.astype(np.int32)
         try:
-            dev["arena"] = kern(dev["arena"], jax.device_put(lut))
+            if ssl is not None:
+                dev["arena"] = ssl.remap(dev["arena"],
+                                         jax.device_put(lut))
+                self.sharded_dispatches += 1
+            else:
+                kern = lattice.session_remap_kernel(dev["cap"], lcap)
+                dev["arena"] = kern(dev["arena"], jax.device_put(lut))
         except Exception as e:  # noqa: BLE001 — arena unchanged
             # (functional update): the host engine continues with the
             # un-remapped caches; the device caller re-checks _dev
@@ -1872,12 +1983,23 @@ class SessionExecutor:
             return
         self.session_stats["remap_dispatches"] += 1
         new_code = np.full(len(dev["mir_code"]), -1, np.int64)
-        new_code[live] = np.searchsorted(live_codes,
-                                         dev["mir_code"][live])
+        pos = np.searchsorted(live_codes, dev["mir_code"][live])
+        new_code[live] = new_of[pos]
+        if ssl is not None:
+            # dead mirror rows still occupy arena slots: keep their
+            # shard residue (negative = poison, residue survives the
+            # floor modulo) so per-shard slot ranks stay aligned
+            new_code[~live] = dev["mir_code"][~live] % ns - ns
         dev["mir_code"] = new_code
-        new_rev = [self._code_rev[int(c)] for c in live_codes]
+        # sharded new codes are class-strided, so the reverse index may
+        # carry holes (None); only live codes ever decode through it
+        top = int(new_of.max()) + 1 if len(live_codes) else 0
+        new_rev: list = [None] * top
+        for c, nc in zip(live_codes.tolist(), new_of.tolist()):
+            new_rev[nc] = self._code_rev[c]
         self._code_rev = new_rev
-        self._code_of = {k: i for i, k in enumerate(new_rev)}
+        self._code_of = {k: i for i, k in enumerate(new_rev)
+                         if k is not None}
         self._raw_memo = {}
         self._code_cols_cache = (-1, [])
 
@@ -1935,9 +2057,25 @@ class SessionExecutor:
         from hstream_tpu.engine import lattice
 
         dev = self._dev
-        slots = lattice.pad_slots(idx)
         if FAULTS.active:  # chaos: fail/delay a session extract
             FAULTS.point("device.session.dispatch")
+        ssl = dev.get("ssl")
+        if ssl is not None:
+            # per-shard slot lists [n_shards, pcap] (-1 pads), each
+            # shard's in the order its rows appear in idx — the order
+            # _flatten_sharded_extract reassembles by
+            cls, slot = self._shard_slots()
+            sel = cls[idx]
+            per = np.bincount(sel, minlength=ssl.n_shards)
+            pcap = round_up_pow2(max(int(per.max()), 1), lo=1)
+            slots = np.full((ssl.n_shards, pcap), -1, np.int32)
+            for s in range(ssl.n_shards):
+                v = slot[idx[sel == s]]
+                slots[s, :len(v)] = v
+            self.sharded_dispatches += 1
+            with kernel_family("close", self.dispatch_observer):
+                return ssl.extract(dev["arena"], slots)
+        slots = lattice.pad_slots(idx)
         kern = lattice.session_extract_kernel(dev["spec"], dev["cap"],
                                               len(slots))
         with kernel_family("close", self.dispatch_observer):
@@ -1994,9 +2132,28 @@ class SessionExecutor:
 
             jax.block_until_ready(self._dev["arena"])
 
+    @staticmethod
+    def _flatten_sharded_extract(packed: np.ndarray,
+                                 codes: np.ndarray) -> np.ndarray:
+        """Sharded extract buffer [n_shards, 1 + n_aggs, pcap] -> the
+        single-chip [1 + n_aggs, k] layout: row r of the close's codes
+        snapshot sits at (its shard, its rank among the snapshot's rows
+        of that shard) — the order _dispatch_extract built the per-shard
+        slot lists in. Works on deferred buffers too: the codes snapshot
+        predates any compaction, and the remap preserves residues."""
+        ns = packed.shape[0]
+        cls = (codes % ns).astype(np.int64)
+        rank = np.empty(len(codes), np.int64)
+        for s in range(ns):
+            m = cls == s
+            rank[m] = np.arange(int(m.sum()))
+        return np.ascontiguousarray(packed[cls, :, rank].T)
+
     def _decode_close(self, packed: np.ndarray, codes, t0, t1,
                       keys=None):
         k = len(codes)
+        if packed.ndim == 3:  # sharded extract: [n_shards, rows, pcap]
+            packed = self._flatten_sharded_extract(packed, codes)
         if not np.array_equal(packed[0, :k], codes):
             raise AssertionError(
                 "session mirror diverged from device arena codes")
@@ -2039,7 +2196,8 @@ class SessionExecutor:
             for g in range(len(self.group_cols)):
                 arr = np.empty(version, object)
                 for i, key in enumerate(self._code_rev):
-                    arr[i] = key[g]
+                    if key is not None:  # sharded-compaction hole
+                        arr[i] = key[g]
                 out.append(arr)
             self._code_cols_cache = (version, out)
         return self._code_cols_cache[1]

@@ -200,12 +200,12 @@ def restore_executor(plan, blob: bytes, *, initial_keys: int = 1024,
     elif kind == "join":
         ex = _restore_join(plan, meta, arrays,
                            initial_keys=initial_keys,
-                           batch_capacity=batch_capacity)
+                           batch_capacity=batch_capacity, mesh=mesh)
     elif kind == "lattice":
         ex = _restore_lattice(plan.node, meta, arrays,
                               batch_capacity=batch_capacity, mesh=mesh)
     elif kind == "session":
-        ex = _restore_session(plan.node, meta)
+        ex = _restore_session(plan.node, meta, mesh=mesh)
     elif kind == "stateless":
         from hstream_tpu.engine.stateless import StatelessExecutor
 
@@ -388,11 +388,16 @@ def _session_state(ex) -> dict:
     }
 
 
-def _restore_session(node, meta):
+def _restore_session(node, meta, mesh=None):
+    """Session snapshots are mesh-portable: the blob holds the gathered
+    host view, so restoring with a different `mesh` (or none) just
+    re-shards when the device path re-activates on the next batch."""
     from hstream_tpu.engine.session import SessionExecutor, _Session
 
     schema = Schema(tuple((n, ColumnType(t)) for n, t in meta["schema"]))
-    ex = SessionExecutor(node, schema, emit_changes=meta["emit_changes"])
+    kw = {} if mesh is None else {"mesh": mesh}
+    ex = SessionExecutor(node, schema, emit_changes=meta["emit_changes"],
+                         **kw)
     ex.watermark = meta["watermark"]
     for ent in meta["sessions"]:
         key = tuple(_dec(ent["k"]))
@@ -438,6 +443,10 @@ def _restore_table_join(plan, meta, arrays, *, initial_keys: int,
 
 
 # ---- join -------------------------------------------------------------------
+#
+# (The stream-TABLE join above stays single-chip — mesh_exclusion_reason
+# keeps its keyed last-value state on the host — so its restore takes no
+# mesh. The interval join below re-shards.)
 
 def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
     if getattr(ex, "_staged", None) or getattr(ex, "_pending_matches",
@@ -475,12 +484,16 @@ def _join_state(ex) -> tuple[dict, dict[str, np.ndarray]]:
 
 
 def _restore_join(plan, meta, arrays, *, initial_keys: int,
-                  batch_capacity: int):
+                  batch_capacity: int, mesh=None):
+    """Join snapshots are mesh-portable like session ones: the blob
+    holds the gathered host store view; a different `mesh` re-shards
+    both side stores when the device path re-activates."""
     from hstream_tpu.engine.join import JoinExecutor
 
     ex = JoinExecutor(plan, initial_keys=initial_keys,
                       batch_capacity=meta.get("batch_capacity",
-                                              batch_capacity))
+                                              batch_capacity),
+                      mesh=mesh)
     ex.watermark = meta["watermark"]
     for side, ents in meta["stores"].items():
         codes: list[int] = []
@@ -507,9 +520,13 @@ def _restore_join(plan, meta, arrays, *, initial_keys: int,
         ex._stores[side].insert_sorted(code_a[order], ts_a[order],
                                        rows_a[order])
     if "i/blob" in arrays:
+        # the downstream aggregate re-shards with the join: a mixed
+        # sharded-join / single-chip-inner pair would refuse the fused
+        # feed plan (correct, but a silent perf cliff)
         inner, _ = restore_executor(ex._inner_plan,
                                     arrays["i/blob"].tobytes(),
                                     initial_keys=initial_keys,
-                                    batch_capacity=batch_capacity)
+                                    batch_capacity=batch_capacity,
+                                    mesh=mesh)
         ex._inner = inner
     return ex

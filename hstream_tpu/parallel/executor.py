@@ -38,6 +38,10 @@ class ShardedQueryExecutor(QueryExecutor):
         self._mesh = mesh
         self._data_axis = data_axis
         self._key_axis = key_axis
+        # device dispatches that ran under shard_map (step + drain);
+        # the query task mirrors deltas into the sharded_dispatches
+        # stat family
+        self.sharded_dispatches = 0
         super().__init__(node, schema, emit_changes=emit_changes,
                          initial_keys=initial_keys,
                          batch_capacity=batch_capacity)
@@ -141,6 +145,7 @@ class ShardedQueryExecutor(QueryExecutor):
             np.asarray(ts_rel, dtype=np.int32), valid,
             cols, null_masks, self._layout)
         self.state = self._step(self.state, wm_rel, packed)
+        self.sharded_dispatches += 1
 
     # contract: dispatches<=1 fetches<=1
     def _drain_changes(self):
@@ -151,6 +156,7 @@ class ShardedQueryExecutor(QueryExecutor):
         from hstream_tpu.common.columnar import extend_rows
 
         self.state, packed = self._extract_touched(self.state)
+        self.sharded_dispatches += 1
         packed = np.asarray(packed)        # [n_key_shards, rows, max_out]
         out = None
         for s in range(self._sharded.n_key):

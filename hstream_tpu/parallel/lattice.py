@@ -28,9 +28,11 @@ reserved for the log-store replication plane (hstream_tpu.store).
 
 The shard_map hygiene here (collectives only inside mesh bodies, no
 host callbacks/fetches in them, axis names spelled consistently) is
-checked by the tools/analyze shardmap pass — the CI jax build lacks
-shard_map entirely, so these mistakes would otherwise surface only on
-real mesh hardware.
+checked by the tools/analyze shardmap pass, and the kernels run for
+real in CI on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8); the static pass
+still catches the classes — per-shard host syncs, axis typos — that
+only real ICI latency or multi-host meshes would trip.
 """
 
 from __future__ import annotations
@@ -53,6 +55,21 @@ from hstream_tpu.engine.lattice import (
     init_value,
     plane_merge_kinds,
 )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: new enough builds export it
+    top-level (`check_vma`); older ones ship the same transform as
+    jax.experimental.shard_map (`check_rep`). One wrapper keeps every
+    sharded kernel importable — and testable on the CPU mesh — on both."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 _MERGE = {
     "sum": jax.lax.psum,
@@ -167,7 +184,7 @@ class ShardedLattice:
             return {k: v[None] for k, v in new.items()}
 
         # packed batch [rows, B]: rows replicated, records sharded on data
-        self.step = jax.jit(jax.shard_map(
+        self.step = jax.jit(shard_map(
             step_local, mesh=mesh,
             in_specs=(spec_tree, P(), P(None, data_axis)),
             out_specs=spec_tree, check_vma=False))
@@ -189,7 +206,7 @@ class ShardedLattice:
                                              ws, outs)
 
         # packed [2+n_aggs, K] — key axis concatenated over shards
-        self.extract_slot = jax.jit(jax.shard_map(
+        self.extract_slot = jax.jit(shard_map(
             extract_local, mesh=mesh,
             in_specs=(spec_tree, P()),
             out_specs=P(None, key_axis), check_vma=False))
@@ -210,7 +227,7 @@ class ShardedLattice:
                 EMPTY_START)
             return out
 
-        self.reset_slot = jax.jit(jax.shard_map(
+        self.reset_slot = jax.jit(shard_map(
             reset_local, mesh=mesh,
             in_specs=(spec_tree, P()),
             out_specs=spec_tree, check_vma=False))
@@ -259,18 +276,18 @@ class ShardedLattice:
             packed = _extract_slots_local(state, slots)
             return _reset_slots_local(state, slots), packed
 
-        self.extract_reset_slots = jax.jit(jax.shard_map(
+        self.extract_reset_slots = jax.jit(shard_map(
             extract_reset_local, mesh=mesh,
             in_specs=(spec_tree, P()),
             out_specs=(spec_tree, P(None, None, key_axis)),
             check_vma=False))
 
-        self.extract_slots = jax.jit(jax.shard_map(
+        self.extract_slots = jax.jit(shard_map(
             _extract_slots_local, mesh=mesh,
             in_specs=(spec_tree, P()),
             out_specs=P(None, None, key_axis), check_vma=False))
 
-        self.reset_slots = jax.jit(jax.shard_map(
+        self.reset_slots = jax.jit(shard_map(
             _reset_slots_local, mesh=mesh,
             in_specs=(spec_tree, P()),
             out_specs=spec_tree, check_vma=False))
@@ -303,7 +320,7 @@ class ShardedLattice:
             return out_state, packed[None]
 
         # packed per-key-shard buffers stacked on a leading axis
-        self.extract_touched = jax.jit(jax.shard_map(
+        self.extract_touched = jax.jit(shard_map(
             touched_local, mesh=mesh,
             in_specs=(spec_tree,),
             out_specs=(spec_tree, P(key_axis)), check_vma=False))
@@ -327,7 +344,16 @@ class ShardedJoinLattice:
     ``probe_insert(mine, other, batch, n, within, cutoff)`` returns
     (mine', packed [rows, n_shards * match_cap]); ``evict(left, right,
     cutoff, delta)`` compacts both sides per shard and returns the
-    per-shard live counts [n_shards, 2]."""
+    per-shard live counts [n_shards, 2]. Kernels are built lazily and
+    cached per (batch capacity, match capacity) — the sharded mirror of
+    the lru-cached single-chip factories — so the executor's sticky
+    capacity ladders reuse compiled shapes instead of retracing.
+
+    ``probe_insert_step`` is the fully fused form: the per-shard match
+    feed is CONCATenated over ICI (one ``all_gather`` along the key
+    axis — the only collective in the hot path) and scattered straight
+    into the already-sharded downstream aggregate lattice, so matched
+    pairs never leave the device."""
 
     def __init__(self, mesh: Mesh, key_axis: str, cap: int, bcap: int,
                  match_cap: int, n_cols_l: int, n_cols_r: int):
@@ -338,32 +364,43 @@ class ShardedJoinLattice:
         self.bcap = bcap
         self.match_cap = match_cap
         self.n_cols = {"l": n_cols_l, "r": n_cols_r}
-        self._build()
+        self._store_spec = {k: P(key_axis) for k in ("code", "ts",
+                                                     "flags", "cols")}
+        self._probe_kerns: dict = {}
+        self._probe_only_kerns: dict = {}
+        self._evict_kerns: dict = {}
+        self._fused_kerns: dict = {}
 
-    def init_store(self, side: str) -> dict[str, jnp.ndarray]:
+    def store_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.key_axis))
+
+    def init_store(self, side: str, cap: int | None = None
+                   ) -> dict[str, jnp.ndarray]:
         """Per-shard empty stores stacked on a leading shard axis and
         placed with the key-axis sharding."""
-        local = lattice.init_join_store(self.cap, self.n_cols[side])
+        local = lattice.init_join_store(cap or self.cap,
+                                        self.n_cols[side])
         out = {}
         for k, v in local.items():
             g = jnp.broadcast_to(v[None], (self.n_shards,) + v.shape)
-            out[k] = jax.device_put(g, NamedSharding(
-                self.mesh, P(self.key_axis)))
+            out[k] = jax.device_put(g, self.store_sharding())
         return out
 
-    def _build(self) -> None:
+    def put_store(self, host: Mapping[str, np.ndarray]):
+        """Host planes [n_shards, cap, ...] -> device, key-sharded."""
+        return {k: jax.device_put(jnp.asarray(v), self.store_sharding())
+                for k, v in host.items()}
+
+    def _build_probe_insert(self, nm: int, bcap: int, match_cap: int):
         mesh, key_axis = self.mesh, self.key_axis
         n_shards = self.n_shards
-        bcap, match_cap = self.bcap, self.match_cap
-        store_spec = {k: P(key_axis) for k in ("code", "ts", "flags",
-                                               "cols")}
+        store_spec = self._store_spec
 
         def owned_mask(bcode):
             shard = jax.lax.axis_index(key_axis)
             return (bcode % n_shards) == shard
 
-        def probe_insert_local(mine, other, batch, n, within, cutoff,
-                               nm, no):
+        def probe_insert_local(mine, other, batch, n, within, cutoff):
             m = {k: v[0] for k, v in mine.items()}
             o = {k: v[0] for k, v in other.items()}
             owned = owned_mask(batch[0])
@@ -374,22 +411,36 @@ class ShardedJoinLattice:
                                        owned=owned)
             return {k: v[None] for k, v in new.items()}, packed
 
-        def mk_probe(nm, no):
-            def f(mine, other, batch, n, within, cutoff):
-                return probe_insert_local(mine, other, batch, n,
-                                          within, cutoff, nm, no)
+        # match buffers concatenate along the COLUMN axis: global
+        # [rows, n_shards * match_cap], per-shard headers at column
+        # s * match_cap
+        return jax.jit(shard_map(
+            probe_insert_local, mesh=mesh,
+            in_specs=(store_spec, store_spec, P(), P(), P(), P()),
+            out_specs=(store_spec, P(None, key_axis)),
+            check_vma=False))
 
-            return jax.jit(jax.shard_map(
-                f, mesh=mesh,
-                in_specs=(store_spec, store_spec, P(), P(), P(), P()),
-                out_specs=(store_spec, P(key_axis)), check_vma=False))
+    def _build_probe_only(self, nm: int, bcap: int, match_cap: int):
+        mesh, key_axis = self.mesh, self.key_axis
+        n_shards = self.n_shards
+        store_spec = self._store_spec
 
-        self.probe_insert_l = mk_probe(self.n_cols["l"],
-                                       self.n_cols["r"])
-        self.probe_insert_r = mk_probe(self.n_cols["r"],
-                                       self.n_cols["l"])
+        def probe_only_local(other, batch, n, within, cutoff):
+            o = {k: v[0] for k, v in other.items()}
+            shard = jax.lax.axis_index(key_axis)
+            owned = (batch[0] % n_shards) == shard
+            return lattice._join_probe(o, batch, n, within, cutoff,
+                                       bcap, match_cap, nm,
+                                       owned=owned)
 
-        cap = self.cap
+        return jax.jit(shard_map(
+            probe_only_local, mesh=mesh,
+            in_specs=(store_spec, P(), P(), P(), P()),
+            out_specs=P(None, key_axis), check_vma=False))
+
+    def _build_evict(self, cap: int):
+        mesh, key_axis = self.mesh, self.key_axis
+        store_spec = self._store_spec
 
         def evict_local(left, right, cutoff, delta):
             def _core(code, ts):
@@ -410,17 +461,117 @@ class ShardedJoinLattice:
                 ns.append(n)
             return outs[0], outs[1], jnp.stack(ns)[None]
 
-        self.evict = jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             evict_local, mesh=mesh,
             in_specs=(store_spec, store_spec, P(), P()),
             out_specs=(store_spec, store_spec, P(key_axis)),
             check_vma=False))
 
+    def _build_probe_insert_step(self, nm: int, inner: ShardedLattice,
+                                 feed_plan, nulls_plan, filter_nulls,
+                                 bcap: int, match_cap: int):
+        mesh, key_axis = self.mesh, self.key_axis
+        n_shards = self.n_shards
+        store_spec = self._store_spec
+        spec_tree = {k: inner.state_spec(k)
+                     for k in lattice.init_state(inner.spec)}
+        local_step = inner._local_step
+        Kl = inner.local_spec.n_keys
+        n_data, data_axis = inner.n_data, inner.data_axis
+        inner_key = inner.key_axis
+        mc = match_cap
+
+        def join_step_local(mine, other, batch, n, within, cutoff,
+                            inner_state, wm_rel, ts_off):
+            m = {k: v[0] for k, v in mine.items()}
+            o = {k: v[0] for k, v in other.items()}
+            owned = ((batch[0] % n_shards)
+                     == jax.lax.axis_index(key_axis))
+            total, kid, jts, valid, cols = lattice._join_match_feed(
+                o, batch, n, within, cutoff, bcap, mc,
+                feed_plan, nulls_plan, filter_nulls, owned=owned)
+            # ICI concat point: the per-shard match segments gather
+            # into one [n_shards * match_cap] feed, replicated along
+            # the key axis so every key shard sees every match and the
+            # ownership scatter below re-routes by AGGREGATE key
+            # (join key and group key need not shard alike)
+            kid = jax.lax.all_gather(kid, key_axis, tiled=True)
+            jts = jax.lax.all_gather(jts, key_axis, tiled=True)
+            valid = jax.lax.all_gather(valid, key_axis, tiled=True)
+            cols = {k: jax.lax.all_gather(v, key_axis, tiled=True)
+                    for k, v in cols.items()}
+            midx = jnp.arange(n_shards * mc, dtype=jnp.int32)
+            if n_data > 1:
+                dmine = ((midx % n_data)
+                         == jax.lax.axis_index(data_axis))
+            else:
+                dmine = jnp.ones_like(midx, dtype=jnp.bool_)
+            off = (jax.lax.axis_index(inner_key) * Kl
+                   if inner_key else 0)
+            kid_l = kid - off
+            ok = valid & dmine & (kid_l >= 0) & (kid_l < Kl)
+            loc = {k: v[0] for k, v in inner_state.items()}
+            new_inner = local_step(loc, wm_rel, kid_l, jts + ts_off,
+                                   ok, cols,
+                                   slot_valid=valid & dmine)
+            new_mine = lattice._join_insert(m, batch, n, bcap, nm,
+                                            owned=owned)
+            return ({k: v[None] for k, v in new_mine.items()},
+                    {k: v[None] for k, v in new_inner.items()},
+                    total[None])
+
+        return jax.jit(shard_map(
+            join_step_local, mesh=mesh,
+            in_specs=(store_spec, store_spec, P(), P(), P(), P(),
+                      spec_tree, P(), P()),
+            out_specs=(store_spec, spec_tree, P(key_axis)),
+            check_vma=False))
+
     def probe_insert(self, side: str, mine, other, batch, n, within,
-                     cutoff):
-        fn = (self.probe_insert_l if side == "l"
-              else self.probe_insert_r)
+                     cutoff, match_cap: int | None = None):
+        mc = self.match_cap if match_cap is None else match_cap
+        key = (side, batch.shape[1], mc)
+        fn = self._probe_kerns.get(key)
+        if fn is None:
+            fn = self._probe_kerns[key] = self._build_probe_insert(
+                self.n_cols[side], batch.shape[1], mc)
         return fn(mine, other, batch, n, within, cutoff)
+
+    def probe_only(self, side: str, other, batch, n, within, cutoff,
+                   match_cap: int):
+        key = (side, batch.shape[1], match_cap)
+        fn = self._probe_only_kerns.get(key)
+        if fn is None:
+            fn = self._probe_only_kerns[key] = self._build_probe_only(
+                self.n_cols[side], batch.shape[1], match_cap)
+        return fn(other, batch, n, within, cutoff)
+
+    def probe_insert_step(self, side: str, inner: ShardedLattice,
+                          mine, other, batch, n, within, cutoff,
+                          inner_state, wm_rel, ts_off, *,
+                          feed_plan, nulls_plan, filter_nulls,
+                          match_cap: int | None = None):
+        """Fused probe + insert + downstream-aggregate scatter, one
+        dispatch; returns (mine', inner_state', per-shard totals
+        i32[n_shards]). `inner` is the query's ShardedLattice (same
+        mesh); the fused kernel is cached per (side, inner, shapes)."""
+        mc = self.match_cap if match_cap is None else match_cap
+        key = (side, inner, batch.shape[1], mc, feed_plan,
+               nulls_plan, filter_nulls)
+        fn = self._fused_kerns.get(key)
+        if fn is None:
+            fn = self._fused_kerns[key] = self._build_probe_insert_step(
+                self.n_cols[side], inner, feed_plan, nulls_plan,
+                filter_nulls, batch.shape[1], mc)
+        return fn(mine, other, batch, n, within, cutoff, inner_state,
+                  wm_rel, ts_off)
+
+    def evict(self, left, right, cutoff, delta):
+        cap = left["code"].shape[1]
+        fn = self._evict_kerns.get(cap)
+        if fn is None:
+            fn = self._evict_kerns[cap] = self._build_evict(cap)
+        return fn(left, right, cutoff, delta)
 
     def unpack_matches(self, packed: np.ndarray, side: str):
         """Flatten the shard-concatenated match buffer into host arrays
@@ -429,10 +580,11 @@ class ShardedJoinLattice:
         lattice.unpack_join_matches. `total` sums the per-shard headers;
         truncation per shard is visible as total > len(kid)."""
         nm = self.n_cols[side]
+        match_cap = packed.shape[1] // self.n_shards
         parts = []
         total = 0
         for s in range(self.n_shards):
-            seg = packed[:, s * self.match_cap:(s + 1) * self.match_cap]
+            seg = packed[:, s * match_cap:(s + 1) * match_cap]
             t, kid, jts, mf, of, mc, oc = lattice.unpack_join_matches(
                 seg, nm)
             total += t
@@ -444,3 +596,189 @@ class ShardedJoinLattice:
                 np.concatenate([p[3] for p in parts]),
                 np.concatenate([p[4] for p in parts], axis=1),
                 np.concatenate([p[5] for p in parts], axis=1))
+
+
+# ---- key-sharded session arena ----------------------------------------------
+#
+# Session chain merge is KEY-LOCAL (a session never spans keys), so the
+# arena shards exactly like the join stores: each key shard keeps its
+# own (code, t0)-sorted arena slice for the codes with
+# ``code % n_shards == shard``, the packed batch / segment feed is
+# replicated along the key axis, and an ownership mask does the routing
+# — unowned records have their valid bit cleared (record mode) or their
+# segment code rewritten to the sentinel (segment mode), which the
+# single-chip kernels already treat as "drop" (their scatters are all
+# mode="drop" at dest=cap). Zero collectives anywhere: step, merge,
+# extract and remap are all embarrassingly per-shard; the host keeps
+# the global interval mirror plus a per-shard slot index so late-drop
+# and close decisions still resolve with zero device syncs.
+
+
+class ShardedSessionLattice:
+    """The session arena of one query, key-sharded over a mesh axis.
+
+    Capacities are PER SHARD. Kernels wrap the lru-cached single-chip
+    session factories under shard_map, built lazily and cached per
+    shape so the executor's sticky capacity ladders reuse compiled
+    shapes instead of retracing."""
+
+    def __init__(self, mesh: Mesh, key_axis: str, spec, schema,
+                 layout):
+        self.mesh = mesh
+        self.key_axis = key_axis
+        self.n_shards = mesh.shape[key_axis]
+        self.spec = spec
+        self.schema = schema
+        self.layout = layout
+        self._plane_names = tuple(lattice.session_plane_np(spec, 1))
+        self._arena_spec = {k: P(key_axis) for k in self._plane_names}
+        self._step_kerns: dict = {}
+        self._merge_kerns: dict = {}
+        self._extract_kerns: dict = {}
+        self._remap_kerns: dict = {}
+
+    def arena_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.key_axis))
+
+    def init_arena(self, cap: int) -> dict[str, jnp.ndarray]:
+        """Per-shard empty arenas stacked on a leading shard axis and
+        placed with the key-axis sharding."""
+        local = lattice.session_plane_np(self.spec, cap)
+        return {k: jax.device_put(
+            jnp.broadcast_to(jnp.asarray(v)[None],
+                             (self.n_shards,) + v.shape),
+            self.arena_sharding()) for k, v in local.items()}
+
+    def put_arena(self, host: Mapping[str, np.ndarray]):
+        """Host planes [n_shards, cap, ...] -> device, key-sharded."""
+        return {k: jax.device_put(jnp.asarray(v), self.arena_sharding())
+                for k, v in host.items()}
+
+    def grow_arena(self, arena, new_cap: int):
+        """Copy every shard's slice into a fresh wider arena (identity
+        fill past the old capacity), like lattice.grow_session_arena."""
+        fresh = lattice.session_plane_np(self.spec, new_cap)
+        out = {}
+        for k, v in arena.items():
+            g = jnp.broadcast_to(jnp.asarray(fresh[k])[None],
+                                 (self.n_shards,) + fresh[k].shape)
+            out[k] = jax.device_put(g.at[:, :v.shape[1]].set(v),
+                                    self.arena_sharding())
+        return out
+
+    def _build_step(self, cap: int, bcap: int):
+        base = lattice.session_step_kernel(self.spec, self.schema,
+                                           self.layout, cap, bcap)
+        mesh, key_axis = self.mesh, self.key_axis
+        n_shards = self.n_shards
+        aspec = self._arena_spec
+
+        def session_step_local(arena, packed, gap, close_cut, delta):
+            loc = {k: v[0] for k, v in arena.items()}
+            owned = ((packed[0] % n_shards)
+                     == jax.lax.axis_index(key_axis))
+            # ownership routing: clear the valid bit (flags bit 0) of
+            # records other shards own — the kernel maps invalid
+            # records to the sentinel code and drops their scatters
+            routed = packed.at[2].set(
+                jnp.where(owned, packed[2], packed[2] & ~1))
+            new = base(loc, routed, gap, close_cut, delta)
+            return {k: v[None] for k, v in new.items()}
+
+        return jax.jit(shard_map(
+            session_step_local, mesh=mesh,
+            in_specs=(aspec, P(), P(), P(), P()),
+            out_specs=aspec, check_vma=False))
+
+    def _build_merge(self, cap: int, scap: int, seg_keys: tuple):
+        base = lattice.session_merge_kernel(self.spec, cap, scap)
+        mesh, key_axis = self.mesh, self.key_axis
+        n_shards = self.n_shards
+        aspec = self._arena_spec
+        seg_spec = {k: P() for k in seg_keys}
+
+        def session_merge_local(arena, seg, gap, close_cut, delta):
+            loc = {k: v[0] for k, v in arena.items()}
+            owned = ((seg["code"] % n_shards)
+                     == jax.lax.axis_index(key_axis))
+            s2 = dict(seg)
+            s2["code"] = jnp.where(
+                owned & (seg["code"] < lattice.SESSION_SENT_CODE),
+                seg["code"], lattice.SESSION_SENT_CODE)
+            new = base(loc, s2, gap, close_cut, delta)
+            return {k: v[None] for k, v in new.items()}
+
+        return jax.jit(shard_map(
+            session_merge_local, mesh=mesh,
+            in_specs=(aspec, seg_spec, P(), P(), P()),
+            out_specs=aspec, check_vma=False))
+
+    def _build_extract(self, cap: int, pcap: int):
+        base = lattice.session_extract_kernel(self.spec, cap, pcap)
+        mesh, key_axis = self.mesh, self.key_axis
+        aspec = self._arena_spec
+
+        def session_extract_local(arena, slots):
+            loc = {k: v[0] for k, v in arena.items()}
+            return base(loc, slots[0])[None]
+
+        return jax.jit(shard_map(
+            session_extract_local, mesh=mesh,
+            in_specs=(aspec, P(key_axis)),
+            out_specs=P(key_axis), check_vma=False))
+
+    def _build_remap(self, cap: int, lcap: int):
+        base = lattice.session_remap_kernel(cap, lcap)
+        mesh = self.mesh
+        aspec = self._arena_spec
+
+        def session_remap_local(arena, lut):
+            loc = {k: v[0] for k, v in arena.items()}
+            new = base(loc, lut)
+            return {k: v[None] for k, v in new.items()}
+
+        return jax.jit(shard_map(
+            session_remap_local, mesh=mesh,
+            in_specs=(aspec, P()),
+            out_specs=aspec, check_vma=False))
+
+    def step(self, arena, packed, gap, close_cut, delta):
+        """Record-mode micro-batch: arena' — one dispatch, no fetch."""
+        cap, bcap = arena["code"].shape[1], packed.shape[1]
+        fn = self._step_kerns.get((cap, bcap))
+        if fn is None:
+            fn = self._step_kerns[(cap, bcap)] = self._build_step(
+                cap, bcap)
+        return fn(arena, packed, gap, close_cut, delta)
+
+    def merge(self, arena, seg, gap, close_cut, delta):
+        """Segment-mode micro-batch: arena' — one dispatch, no fetch."""
+        cap = arena["code"].shape[1]
+        scap = seg["code"].shape[0]
+        seg_keys = tuple(sorted(seg))
+        fn = self._merge_kerns.get((cap, scap, seg_keys))
+        if fn is None:
+            fn = self._merge_kerns[(cap, scap, seg_keys)] = \
+                self._build_merge(cap, scap, seg_keys)
+        return fn(arena, seg, gap, close_cut, delta)
+
+    def extract(self, arena, slots):
+        """Finalized rows for per-shard slot lists [n_shards, pcap]
+        (-1 pads) -> packed [n_shards, 1 + n_aggs, pcap]."""
+        cap, pcap = arena["code"].shape[1], slots.shape[1]
+        fn = self._extract_kerns.get((cap, pcap))
+        if fn is None:
+            fn = self._extract_kerns[(cap, pcap)] = self._build_extract(
+                cap, pcap)
+        return fn(arena, slots)
+
+    def remap(self, arena, lut):
+        """Rewrite arena codes through a replicated LUT (compaction).
+        The LUT must be residue-class preserving (new % n_shards ==
+        old % n_shards) so entries never change owner shard."""
+        cap, lcap = arena["code"].shape[1], lut.shape[0]
+        fn = self._remap_kerns.get((cap, lcap))
+        if fn is None:
+            fn = self._remap_kerns[(cap, lcap)] = self._build_remap(
+                cap, lcap)
+        return fn(arena, lut)

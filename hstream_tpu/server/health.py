@@ -153,6 +153,7 @@ def evaluate_query(ctx, qid: str, *, now_ms: float | None = None,
     backlog = 0
     stuck_ms = 0.0
     fallbacks = late = 0
+    shards = 0
     owner = None
 
     if breaker_open:
@@ -184,6 +185,7 @@ def evaluate_query(ctx, qid: str, *, now_ms: float | None = None,
         stuck_ms = tracker.note_progress(qid, watermark, now)
         fallbacks = task.engine_total("device_fallbacks")
         late = task.engine_total("late_drops")
+        shards = int(getattr(task, "mesh_shards", lambda: 0)() or 0)
         if backlog > 0 and stuck_ms >= stalled_ms:
             stalled.append("no_progress")
         elif backlog > 0 and stuck_ms >= degraded_ms:
@@ -209,6 +211,8 @@ def evaluate_query(ctx, qid: str, *, now_ms: float | None = None,
         "backlog": backlog,
         "device_fallbacks": fallbacks,
         "late_drops": late,
+        # multi-chip plane (ISSUE 16): 0 means single-chip execution
+        "mesh_shards": shards,
         "shed_level": shed_level,
         "restart_pending": restart_pending,
         "breaker_open": breaker_open,
@@ -301,8 +305,15 @@ def sample_health(ctx) -> None:
                         max(0.0, now - wm))
         live.add(("query_watermark_ms", qid))
         live.add(("query_watermark_lag_ms", qid))
+        # multi-chip plane (ISSUE 16): the gauge only exists for
+        # sharded queries — single-chip queries drop it (absent, not
+        # 0) so dashboards can filter on presence
+        shards = int(getattr(task, "mesh_shards", lambda: 0)() or 0)
+        if shards > 1:
+            stats.gauge_set("mesh_shards", qid, shards)
+            live.add(("mesh_shards", qid))
     for metric in ("query_watermark_ms", "query_watermark_lag_ms",
-                   "query_health_level"):
+                   "query_health_level", "mesh_shards"):
         for label in stats.gauge_labels(metric):
             if (metric, label) not in live:
                 stats.gauge_drop(metric, label)

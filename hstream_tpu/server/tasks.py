@@ -185,6 +185,11 @@ class QueryTask(threading.Thread):
         self._late_seen = 0
         self._h2d_seen = 0
         self._d2h_seen = 0
+        # multi-chip plane (ISSUE 16): shard_map dispatch mirror (a
+        # JoinExecutor's property already folds its inner aggregate,
+        # so the mirror reads the executor attr directly — NEVER via
+        # engine_total, which would double-count the inner)
+        self._sharded_seen = 0
         # event-time freshness plane (ISSUE 13): the publish-time
         # watermark of ingested records (max record append/publish ms
         # seen) and the wall clock when it was picked up — emission
@@ -301,6 +306,29 @@ class QueryTask(threading.Thread):
         if inner is not None:
             total += int(getattr(inner, attr, 0))
         return total
+
+    def mesh_shards(self) -> int:
+        """Key-axis size of the running executor's mesh, 0 when the
+        query executes single-chip (no mesh, or a mesh whose key axis
+        is 1 — the executors only build sharded lattices for >1)."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return 0
+        mesh = getattr(ex, "mesh", None)
+        if mesh is None:
+            mesh = getattr(ex, "_mesh", None)  # ShardedQueryExecutor
+        if mesh is None:
+            return 0
+        axis = getattr(ex, "key_axis", None) \
+            or getattr(ex, "_key_axis", "key")
+        try:
+            if axis not in mesh.axis_names:
+                return 0
+            n = int(mesh.shape[axis])
+        except Exception:  # noqa: BLE001 — a half-built mesh must not
+            return 0       # kill the stats sweep
+        return n if n > 1 else 0
 
     def _note_ingest_freshness(self, publish_ms: int) -> None:
         """Called once per ingested chunk with the chunk's max record
@@ -597,6 +625,16 @@ class QueryTask(threading.Thread):
                                       self.plan.source,
                                       d2h - self._d2h_seen)
                 self._d2h_seen = d2h
+            # shard_map dispatches (ISSUE 16): read the executor attr
+            # directly — JoinExecutor.sharded_dispatches is a property
+            # that already folds its inner aggregate, so engine_total
+            # would double-count it
+            sd = int(getattr(ex, "sharded_dispatches", 0) or 0)
+            if sd > self._sharded_seen:
+                stats.stat_add("sharded_dispatches",
+                               self.info.query_id,
+                               float(sd - self._sharded_seen))
+                self._sharded_seen = sd
         except Exception:  # noqa: BLE001 — metrics must not kill
             pass           # the ingest loop
 

@@ -355,17 +355,16 @@ def mesh_exclusion_reason(plan: plans.Plan) -> str | None:
         if sel is None:
             return "not a SELECT plan"
         plan = sel
-    if plan.join is not None:
-        return ("stream-stream/table JOIN keeps two-sided host state; "
-                "the downstream aggregate runs single-chip")
+    if plan.join is not None and getattr(plan.join, "table", False):
+        return ("stream-TABLE JOIN keeps keyed last-value state on the "
+                "host; the probe side runs single-chip")
+    # interval (stream-stream) joins shard: key-sharded side stores with
+    # the fused probe scatter into the sharded aggregate lattice, and
+    # session windows shard their chain-merge arena per key shard — only
+    # the downstream aggregate's own exclusions remain
     from hstream_tpu.engine.plan import AggKind, AggregateNode
-    from hstream_tpu.engine.window import SessionWindow
 
     node = plan.node
-    if isinstance(node, AggregateNode) and isinstance(node.window,
-                                                      SessionWindow):
-        return ("session windows run on the single-chip session "
-                "lattice; the chain-merge arena is not mesh-sharded yet")
     if not isinstance(node, AggregateNode):
         return "stateless plans have no device state to shard"
     if any(a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)
@@ -411,7 +410,13 @@ def explain_text(plan: plans.Plan) -> str:
                                 f"WITHIN {plan.join.within.ms}ms")
         reason = mesh_exclusion_reason(plan)
         if reason is None:
-            lines.append("MESH: shardable (data x key) when --mesh is set")
+            try:
+                import jax
+                nd = jax.device_count()
+            except Exception:  # noqa: BLE001 — EXPLAIN must render
+                nd = 1         # without a device runtime
+            lines.append(f"MESH: shardable over {nd} chips "
+                         "(data x key) when --mesh is set")
         else:
             lines.append(f"MESH: single-chip — {reason}")
         return "\n".join(lines)
@@ -452,17 +457,16 @@ def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
     `sample_rows` refine schema inference (bind_schema). With `mesh`, the
     aggregation lattice is sharded over it (hstream_tpu.parallel)."""
     if plan.join is not None:
-        if mesh is not None:
-            raise SQLCodegenError(
-                "sharded execution of JOIN plans is not supported yet")
         from hstream_tpu.engine.join import JoinExecutor, TableJoinExecutor
 
         # schema inference for the inner executor uses the first JOINED
         # batch (caller sample rows are single-stream shaped)
-        cls = TableJoinExecutor if getattr(plan.join, "table", False) \
-            else JoinExecutor
-        return cls(plan, initial_keys=initial_keys,
-                   batch_capacity=batch_capacity)
+        if getattr(plan.join, "table", False):
+            # TABLE joins keep keyed last-value state on the host
+            return TableJoinExecutor(plan, initial_keys=initial_keys,
+                                     batch_capacity=batch_capacity)
+        return JoinExecutor(plan, initial_keys=initial_keys,
+                            batch_capacity=batch_capacity, mesh=mesh)
     node = plan.node
     if isinstance(node, AggregateNode):
         schema = bind_schema(plan, sample_rows)
@@ -470,7 +474,8 @@ def make_executor(plan: plans.SelectPlan, sample_rows=None, *,
             from hstream_tpu.engine.session import SessionExecutor
 
             return SessionExecutor(node, schema,
-                                   emit_changes=plan.emit_changes)
+                                   emit_changes=plan.emit_changes,
+                                   mesh=mesh)
         if mesh is not None and any(
                 a.kind in (AggKind.TOPK, AggKind.TOPK_DISTINCT)
                 for a in node.aggs):

@@ -135,6 +135,9 @@ GAUGES = [
                               # front but not yet completed (queue
                               # depth across the lanes / completion
                               # queue), sampled at scrape
+    "mesh_shards",            # per query: key-axis shard count of the
+                              # mesh the executor runs on (absent for
+                              # single-chip queries), sampled at scrape
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);

@@ -63,6 +63,12 @@ STAT_FAMILIES = [
                "aggregate rows emitted over the trailing window"),
     StatFamily("close_cycles", "query", "cycles",
                "window close cycles emitted over the trailing window"),
+    # multi-chip execution (ISSUE 16): device dispatches that ran
+    # under shard_map — the rate a sharded query's fused kernels hit
+    # the mesh (zero for single-chip queries)
+    StatFamily("sharded_dispatches", "query", "dispatches",
+               "device dispatches executed under shard_map over the "
+               "trailing window"),
 ]
 
 FAMILY_NAMES = frozenset(f.name for f in STAT_FAMILIES)

@@ -118,6 +118,8 @@ _HELP = {
                               "watermark (answer staleness)",
     "query_health_level": "health-plane verdict: 0 OK / 1 DEGRADED / "
                           "2 STALLED",
+    "mesh_shards": "key-axis shard count of the mesh the query's "
+                   "executor runs on (absent for single-chip queries)",
     "append_latency_ms": "Append RPC latency",
     "fetch_latency_ms": "Fetch RPC latency",
     "sql_execute_latency_ms": "ExecuteQuery RPC latency",

@@ -202,13 +202,22 @@ def test_explain_notes_mesh_exclusion(server_stub):
     stub, ctx = server_stub
     stub.CreateStream(pb.Stream(stream_name="l1"))
     stub.CreateStream(pb.Stream(stream_name="r1"))
+    # interval (stream-stream) joins shard since ISSUE 16 — the mesh
+    # line must name the shardable topology, not an exclusion
     out = stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="EXPLAIN SELECT l1.k, COUNT(*) AS c FROM l1 "
                   "INNER JOIN r1 WITHIN (INTERVAL 1 SECOND) "
                   "ON l1.k = r1.k GROUP BY l1.k, "
                   "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))
     text = rec.struct_to_dict(out.result_set[0])["explain"]
-    assert "MESH: single-chip" in text and "JOIN" in text
+    assert "MESH: shardable" in text and "JOIN" in text
+    # TOPK planes have no elementwise shard merge — still excluded
+    out = stub.ExecuteQuery(pb.CommandQuery(
+        stmt_text="EXPLAIN SELECT k, TOPK(v, 3) AS t FROM l1 "
+                  "GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
+                  "EMIT CHANGES;"))
+    text = rec.struct_to_dict(out.result_set[0])["explain"]
+    assert "MESH: single-chip" in text and "TOPK" in text
     out = stub.ExecuteQuery(pb.CommandQuery(
         stmt_text="EXPLAIN SELECT k, COUNT(*) AS c FROM l1 GROUP BY k, "
                   "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"))

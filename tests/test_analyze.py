@@ -1592,6 +1592,77 @@ def test_shardmap_axis_typo_flagged():
     assert "'dta'" in f.message and "data" in f.message
 
 
+SESSION_SHARDED = '''
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+def build(mesh, key_axis="key"):
+    def step_local(arena, packed):
+        shard = jax.lax.axis_index(key_axis)
+        owned = (packed[0] % 8) == shard
+        packed = packed.at[2].set(
+            jnp.where(owned, packed[2], packed[2] & ~1))
+        return arena, packed
+
+    return jax.jit(jax.shard_map(step_local, mesh=mesh))
+'''
+
+
+def test_shardmap_session_ownership_mask_clean():
+    """ISSUE 16 shape: the sharded session arena's ownership masking
+    (axis_index inside the body, ZERO collectives) must pass clean —
+    axis_index is a mesh-bound primitive, legal only under shard_map,
+    and the session lattice keeps it there."""
+    assert run_one(shardmap, [src("m.py", SESSION_SHARDED)]) == []
+
+
+def test_shardmap_join_concat_gather_clean():
+    """ISSUE 16 shape: the sharded join's ICI concat point — tiled
+    all_gather of per-shard match buffers along the key axis inside
+    the shard_map body — is mesh-legal and must not be flagged."""
+    code = '''
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    def build(mesh, key_axis="key"):
+        def probe_local(store, batch):
+            shard = jax.lax.axis_index(key_axis)
+            kid = batch[2] * 0 + shard
+            kid = jax.lax.all_gather(kid, key_axis, tiled=True)
+            return store, kid
+
+        return jax.jit(jax.shard_map(probe_local, mesh=mesh))
+    '''
+    assert run_one(shardmap, [src("m.py", code)]) == []
+
+
+def test_shardmap_session_gather_outside_body_flagged():
+    """The inverse pin: an all_gather in a helper NEVER wrapped by
+    shard_map (e.g. a session drain trying to concat host-side) is the
+    unbound-axis trap the pass exists for."""
+    code = '''
+    import jax
+
+    def drain_concat(parts):
+        return jax.lax.all_gather(parts, "key", tiled=True)
+    '''
+    out = run_one(shardmap, [src("m.py", code)])
+    assert rules_of(out) == {"shardmap-collective"}
+
+
+def test_shardmap_session_callback_in_body_flagged():
+    """A host fetch inside the session step body (per-shard sync —
+    would serialize the mesh) keeps tripping shardmap-callback."""
+    code = SESSION_SHARDED.replace(
+        "        return arena, packed",
+        "        import numpy as np\n"
+        "        return arena, np.asarray(packed)")
+    out = run_one(shardmap, [src("m.py", code)])
+    assert rules_of(out) == {"shardmap-callback"}
+
+
 # ---- analyze CLI --json ----------------------------------------------------
 
 

@@ -1186,3 +1186,51 @@ def test_witness_disarmed_records_nothing_on_real_subsystems():
     st = LOCKTRACE.status()
     assert st["locks"] == {} and st["edges"] == {} \
         and st["cycles"] == []
+
+
+# ---- ISSUE 16: multi-chip exclusions retired for JOIN + sessions ------------
+
+
+def test_mesh_exclusions_join_and_sessions_retired():
+    """Interval joins and session windows are mesh-sharded since
+    ISSUE 16: the retired exclusion strings must be GONE from the
+    shared predicate (source pin — a revert would resurrect them
+    silently, EXPLAIN and the runtime gate share the predicate),
+    while the two remaining exclusions (TOPK planes, stream-TABLE
+    joins) must still fire."""
+    import inspect
+
+    from hstream_tpu.sql import codegen as cg
+
+    src = inspect.getsource(cg)
+    # retired with the sharded join/session lattices
+    assert "two-sided host state" not in src
+    assert "single-chip session lattice" not in src
+    assert "sharded execution of JOIN plans is not supported" not in src
+
+    plan = cg.stream_codegen(
+        "SELECT l.k, COUNT(*) AS c FROM l INNER JOIN r "
+        "WITHIN (INTERVAL 1 SECOND) ON l.k = r.k GROUP BY l.k, "
+        "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;")
+    assert cg.mesh_exclusion_reason(plan) is None
+    assert "MESH: shardable" in cg.explain_text(plan)
+
+    plan = cg.stream_codegen(
+        "SELECT k, COUNT(*) AS c FROM s GROUP BY k, "
+        "SESSION (INTERVAL 5 SECOND) EMIT CHANGES;")
+    assert cg.mesh_exclusion_reason(plan) is None
+    assert "MESH: shardable" in cg.explain_text(plan)
+
+    # the remaining exclusions stay pinned PRESENT
+    plan = cg.stream_codegen(
+        "SELECT k, TOPK(v, 3) AS t FROM s GROUP BY k, "
+        "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;")
+    reason = cg.mesh_exclusion_reason(plan)
+    assert reason is not None and "TOPK" in reason
+
+    plan = cg.stream_codegen(
+        "SELECT l.k, COUNT(*) AS c FROM l INNER JOIN TABLE(t) "
+        "ON l.k = t.k GROUP BY l.k, "
+        "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;")
+    reason = cg.mesh_exclusion_reason(plan)
+    assert reason is not None and "stream-TABLE" in reason

@@ -32,13 +32,20 @@ def free_port() -> int:
     return p
 
 
-def wait_up(stub, timeout=60.0):
+def wait_up(port, timeout=60.0):
+    """Poll Echo with a FRESH channel per attempt until the server
+    answers, returning (channel, stub). A channel created while the
+    port still refuses connections can wedge in connect-backoff and
+    never recover even after the listener appears."""
     deadline = time.time() + timeout
     while time.time() < deadline:
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stub = HStreamApiStub(ch)
         try:
             stub.Echo(pb.EchoRequest(msg="up"), timeout=1)
-            return
+            return ch, stub
         except grpc.RpcError:
+            ch.close()
             time.sleep(0.3)
     raise TimeoutError("server never came up")
 
@@ -64,9 +71,7 @@ def test_successor_adopts_and_resumes_from_snapshot(tmp_path):
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     qid = None
     try:
-        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
-        stub = HStreamApiStub(ch)
-        wait_up(stub)
+        ch, stub = wait_up(port)
         stub.CreateStream(pb.Stream(stream_name="src"))
         stub.ExecuteQuery(pb.CommandQuery(
             stmt_text="CREATE STREAM snk AS SELECT k, COUNT(*) AS c "

@@ -101,7 +101,8 @@ KERNEL_FACTORIES = {
 }
 # factories returning a NAMESPACE of kernels (attributes are kernels)
 KERNEL_NAMESPACE_FACTORIES = {"compiled", "ShardedLattice",
-                              "ShardedJoinLattice"}
+                              "ShardedJoinLattice",
+                              "ShardedSessionLattice"}
 
 # device-value lexicon: identifier stems that name device arrays in
 # this codebase (packed extract buffers, wire words, lattice state)

@@ -3,7 +3,7 @@
 The sharded lattice runs its hot step under `jax.shard_map` with zero
 collectives; merges (psum/pmin/pmax over the data axis) ride ICI only
 at drain points. Three ways that discipline breaks, each invisible to
-single-device tests (the CI jax build lacks shard_map entirely):
+single-device tests (single-chip runs never bind a mesh axis):
 
   shardmap-collective  a `jax.lax.p*` collective in a function that is
                        never wrapped by shard_map (directly, or called
