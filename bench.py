@@ -1322,8 +1322,13 @@ def _smoke_server_columnar(batches: int = 50) -> int:
     # and the guarded region itself scrapes the stats/cluster-stats
     # verbs — rate ladders, federation fold, and exposition are
     # host-only by construction too
+    # the placer loop is likewise armed DURING the guarded run (ISSUE
+    # 17): node-record publishes, scheduler heartbeats and the adopt/
+    # rebalance sweep are config-store + host work only — steady state
+    # must still compile nothing with placement decisions live
     server, ctx = serve("127.0.0.1", 0, "mem://", trace_sample=1.0,
-                        load_report_interval_ms=500)
+                        load_report_interval_ms=500,
+                        placer_interval_ms=200)
     ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
     stub = HStreamApiStub(ch)
     try:
@@ -1400,6 +1405,8 @@ def _smoke_server_columnar(batches: int = 50) -> int:
             stub.SendAdminCommand(pb.AdminCommandRequest(
                 command="stats",
                 args=_rec.dict_to_struct({"entity": "streams"})))
+            stub.SendAdminCommand(pb.AdminCommandRequest(
+                command="placer", args=_rec.dict_to_struct({})))
             stub.ClusterStats(pb.ClusterStatsRequest())
         return g.count
     finally:

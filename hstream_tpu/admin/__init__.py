@@ -234,6 +234,54 @@ def cmd_assignments(stub, args) -> list[dict]:
     return _admin(stub, "assignments")
 
 
+def cmd_placer(stub, args) -> list[dict]:
+    """Placement plane (ISSUE 17): per-node scores with skip reasons,
+    current placements, the last decision + machine-readable reason,
+    and any co-compile packs."""
+    import json
+
+    resp = _admin(stub, "placer")
+    st = resp[0] if resp else {}
+    if getattr(args, "json", False):
+        print(json.dumps(st, indent=2, sort_keys=True))
+        return []
+    rows = [{"": "placer",
+             "value": "armed" if st.get("armed") else "disarmed",
+             "detail": (f"node {st.get('node')} lease "
+                        f"{st.get('lease_ms')}ms ticks "
+                        f"{st.get('ticks')}")}]
+    for node, n in sorted((st.get("nodes") or {}).items()):
+        rows.append({
+            "": f"node {node}",
+            "value": (f"SKIP {n['skip']}" if n.get("skip")
+                      else f"score {n.get('score')}"),
+            "detail": (f"queries {n.get('running_queries')} rss "
+                       f"{n.get('rss_mb')}MB p99 "
+                       f"{n.get('dispatch_p99_ms')}ms hb_age "
+                       f"{n.get('hb_age_ms')}ms")})
+    for qid, p in sorted((st.get("placements") or {}).items()):
+        age = p.get("hb_age_ms")
+        rows.append({
+            "": f"query {qid}",
+            "value": f"{p.get('state')} @ {p.get('node')}",
+            "detail": (f"epoch {p.get('epoch')}"
+                       + ("" if age is None else f" hb_age {age}ms"))})
+    for pack in st.get("packs") or []:
+        members = pack.get("members") or []
+        rows.append({
+            "": f"pack {pack.get('signature')}",
+            "value": f"{len(members)} member(s)",
+            "detail": ",".join(members)})
+    last = st.get("last_decision")
+    if last:
+        rows.append({
+            "": "last-decision",
+            "value": f"{last.get('action')} {last.get('query')}",
+            "detail": (f"-> {last.get('target')} "
+                       f"reason={last.get('reason')}")})
+    return rows
+
+
 def cmd_quota(stub, args) -> list[dict]:
     """Flow-control quota CRUD over the hierarchical quota tree
     (scopes: cluster | tenant/<ns> | stream/<name>)."""
@@ -448,6 +496,14 @@ def main(argv=None) -> int:
                    help="client-facing address served as the redirect "
                         "hint (defaults to the promoted replica addr)")
     sub.add_parser("assignments", help="query -> server scheduler records")
+    p = sub.add_parser("placer",
+                       help="placement plane: per-node load scores + "
+                            "skip reasons, query placements with "
+                            "heartbeat ages, co-compile packs, last "
+                            "decision with machine-readable reason")
+    p.add_argument("--json", action="store_true",
+                   help="dump the full status (decision ring, raw "
+                        "scores map) as JSON")
     p = sub.add_parser("quota",
                        help="flow-control quotas: get/set/list/unset "
                             "on cluster | tenant/<ns> | stream/<name>")

@@ -45,8 +45,15 @@ class ServerContext:
                  trace_sample: float = 0.0,
                  health_degraded_ms: float | None = None,
                  health_stalled_ms: float | None = None,
-                 load_report_interval_ms: float | None = None):
+                 load_report_interval_ms: float | None = None,
+                 placer_interval_ms: float | None = None,
+                 heartbeat_lease_ms: float | None = None,
+                 pack_queries: bool = False,
+                 owns_store: bool = True):
         self.store = store
+        # in-process multi-node clusters share ONE store across several
+        # contexts; only the context that opened it may close it
+        self.owns_store = owns_store
         # optional jax.sharding.Mesh: when set, eligible aggregate
         # queries execute sharded over it (parallel.ShardedQueryExecutor)
         self.mesh = mesh
@@ -195,6 +202,23 @@ class ServerContext:
             self, interval_s=(DEFAULT_LOAD_REPORT_INTERVAL_S
                               if load_report_interval_ms is None
                               else load_report_interval_ms / 1000.0))
+        # the placer (ISSUE 17): placement + live failover adoption +
+        # rebalance over the CAS scheduler records. Constructed always
+        # (admin `placer` and /metrics read its status), ARMED only when
+        # --placer-interval-ms is set — disarmed it never heartbeats,
+        # never publishes node records and never sweeps, so single-node
+        # deployments keep the pure boot-epoch adoption semantics.
+        # Started by serve() after the port binds, like the reporter.
+        from hstream_tpu.placer import DEFAULT_LEASE_MS, PackPool, Placer
+
+        self.heartbeat_lease_ms = int(
+            DEFAULT_LEASE_MS if heartbeat_lease_ms is None
+            else heartbeat_lease_ms)
+        self.placer = Placer(self, interval_ms=placer_interval_ms,
+                             lease_ms=self.heartbeat_lease_ms)
+        # co-compile packing: compatible queries share one executor /
+        # one dispatch (ISSUE 17c); opt-in via --pack-queries
+        self.pack_pool = PackPool(self) if pack_queries else None
         # the checkpoint-log replay above (LogCheckpointStore) happened
         # before the journal existed: surface any corrupt entries it
         # had to skip as a queryable event now
@@ -227,6 +251,21 @@ class ServerContext:
                            "is racing this store")
 
     def shutdown(self) -> None:
+        # stop the placer before the supervisor: a placement/adoption
+        # sweep racing shutdown would relaunch or move a query the
+        # loop below is about to stop
+        placer = getattr(self, "placer", None)
+        if placer is not None:
+            try:
+                placer.stop()
+            except Exception:
+                pass
+        pool = getattr(self, "pack_pool", None)
+        if pool is not None:
+            try:
+                pool.stop()
+            except Exception:
+                pass
         rep = getattr(self, "load_reporter", None)
         if rep is not None:
             try:
@@ -268,4 +307,5 @@ class ServerContext:
             # worker mid-append against a closed store would fail an
             # acknowledged-in-flight batch
             front.close()
-        self.store.close()
+        if self.owns_store:
+            self.store.close()

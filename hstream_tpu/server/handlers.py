@@ -317,6 +317,11 @@ class HStreamApiServicer:
         sup = getattr(ctx, "supervisor", None)
         if sup is not None:
             sup.resume_fn = self._resume_query
+        # the placer adopts a dead peer's queries through the SAME
+        # snapshot-resume path (live failover adoption, ISSUE 17b)
+        placer = getattr(ctx, "placer", None)
+        if placer is not None:
+            placer.resume_fn = self._resume_query
 
     # ---- misc ---------------------------------------------------------------
 
@@ -835,6 +840,17 @@ class HStreamApiServicer:
             # records stay claimable for a later, healthier boot).
             if not scheduler.adoption_allowed(ctx, info.query_id):
                 continue
+            # armed placer: respect a LIVE peer's heartbeat lease even
+            # at boot — a restarting node must not snatch back queries
+            # a survivor adopted and is actively heartbeating (its
+            # higher boot epoch would win the pure-epoch rule below)
+            if ctx.placer.armed:
+                rec = scheduler.assignment(ctx, info.query_id)
+                if (rec is not None
+                        and rec.get("node") != scheduler.node_name(ctx)
+                        and scheduler.owner_live(
+                            rec, ctx.heartbeat_lease_ms)):
+                    continue
             if not scheduler.try_adopt(ctx, info.query_id):
                 continue
             try:
@@ -1172,6 +1188,10 @@ class HStreamApiServicer:
                 ctx.stats.stream_stat_add("promotions", "_store")
         elif cmd == "assignments":
             out = scheduler.assignments(ctx)
+        elif cmd == "placer":
+            # placements, per-node scores, last decision + machine-
+            # readable reason (ISSUE 17 satellite 1)
+            out = ctx.placer.status()
         elif cmd == "quota-set":
             from hstream_tpu.flow import Quota
 
@@ -1666,6 +1686,30 @@ class HStreamApiServicer:
                          created_time_ms=now_ms(), query_type=qtype,
                          status=TaskStatus.CREATED, sink=sink_stream)
         ctx.persistence.insert_query(info)
+        # co-compile packing (ISSUE 17c): with --pack-queries, a query
+        # whose (source, window, agg-set) signature matches an existing
+        # pack joins that group's shared slot-keyed executor — one
+        # dispatch for all members, nothing compiled for the 2nd..Nth
+        pool = getattr(ctx, "pack_pool", None)
+        if pool is not None:
+            from hstream_tpu.placer.packing import PackRefusal
+
+            member = pool.try_attach(
+                query_id, plan, stream_sink(ctx, sink_stream, sink_type))
+            if not isinstance(member, PackRefusal):
+                scheduler.record_assignment(ctx, query_id)
+                ctx.running_queries[query_id] = member
+                ctx.persistence.set_query_status(
+                    query_id, TaskStatus.RUNNING)
+                return info
+        # placement (ISSUE 17a): an armed placer ranks every node's
+        # published load record; when a less-loaded peer wins, this
+        # node writes an OFFERED scheduler record instead of launching
+        # — the target's adoption sweep claims and resumes it there
+        if qtype == QUERY_STREAM:
+            target = ctx.placer.place_for_launch(query_id)
+            if target is not None:
+                return info
         scheduler.record_assignment(ctx, query_id)
         task = QueryTask(ctx, info, plan,
                          stream_sink(ctx, sink_stream, sink_type))

@@ -176,6 +176,16 @@ def evaluate_query(ctx, qid: str, *, now_ms: float | None = None,
         owner_node = (owner or {}).get("node")
         if owner_node is None or owner_node == scheduler.node_name(ctx):
             stalled.append("unowned")
+        else:
+            # owned by a peer: honor its heartbeat lease. A lapsed
+            # heartbeat means the owner crashed without cleanup — the
+            # query is STALLED "dead" until an armed placer's sweep
+            # adopts it; a FRESH peer heartbeat stays healthy here
+            # (regression pin: live peers are never flagged).
+            age = scheduler.owner_heartbeat_age_ms(owner)
+            lease = int(getattr(ctx, "heartbeat_lease_ms", 10_000))
+            if age is not None and age > lease:
+                stalled.append("dead")
 
     if task is not None:
         watermark = _executor_watermark(task)

@@ -58,7 +58,11 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           trace_sample: float = 0.0,
           health_degraded_ms: float | None = None,
           health_stalled_ms: float | None = None,
-          load_report_interval_ms: float | None = None
+          load_report_interval_ms: float | None = None,
+          placer_interval_ms: float | None = None,
+          heartbeat_lease_ms: float | None = None,
+          pack_queries: bool = False,
+          owns_store: bool = True
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
 
@@ -94,7 +98,11 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         trace_sample=trace_sample,
                         health_degraded_ms=health_degraded_ms,
                         health_stalled_ms=health_stalled_ms,
-                        load_report_interval_ms=load_report_interval_ms)
+                        load_report_interval_ms=load_report_interval_ms,
+                        placer_interval_ms=placer_interval_ms,
+                        heartbeat_lease_ms=heartbeat_lease_ms,
+                        pack_queries=pack_queries,
+                        owns_store=owns_store)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
         # HSTREAM_FAULTS, which ServerContext already loaded)
@@ -137,6 +145,10 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
     # must journal the node's REAL bound identity (host:0 would be a
     # phantom node the placer can't match to later reports)
     ctx.load_reporter.start()
+    # same bind-first rule for the placer: its node record and its
+    # scheduler heartbeats carry server-<id>@host:port, which is only
+    # real after the bind. No-op unless --placer-interval-ms armed it.
+    ctx.placer.start()
     if metrics_port is not None:
         from hstream_tpu.stats.prometheus import serve_exporter
 
@@ -248,6 +260,25 @@ def _parse_args(argv):
                          "event (per-stream rate ladders, query "
                          "health counts, append-front depth, rss — "
                          "the placement load signal; default 30000)")
+    ap.add_argument("--placer-interval-ms", type=float, default=None,
+                    help="ARM the placer loop at this cadence: publish "
+                         "this node's record to cluster/nodes/<node>, "
+                         "heartbeat owned scheduler/query/* records, "
+                         "adopt queries whose owner's heartbeat lease "
+                         "lapsed, rebalance on load skew. Unset (the "
+                         "default) keeps pure boot-epoch adoption with "
+                         "zero background config writes")
+    ap.add_argument("--heartbeat-lease-ms", type=float, default=None,
+                    help="owner-liveness lease: a scheduler record "
+                         "whose heartbeat is older than this is "
+                         "adoptable by any armed survivor "
+                         "(default 10000)")
+    ap.add_argument("--pack-queries", action="store_true", default=None,
+                    help="co-compile packing: bucket compatible "
+                         "queries (same source/window/agg signature) "
+                         "into one shared slot-keyed executor, so N "
+                         "queries ride one dispatch and the 2nd..Nth "
+                         "compiles nothing")
     args = ap.parse_args(argv)
 
     defaults = {"host": "0.0.0.0", "port": 6570, "store": "mem://",
@@ -268,7 +299,10 @@ def _parse_args(argv):
                 "trace_sample": 0.0,
                 "health_degraded_ms": None,
                 "health_stalled_ms": None,
-                "load_report_interval_ms": None}
+                "load_report_interval_ms": None,
+                "placer_interval_ms": None,
+                "heartbeat_lease_ms": None,
+                "pack_queries": False}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -316,7 +350,10 @@ def main(argv=None) -> None:
         trace_sample=cfg["trace_sample"],
         health_degraded_ms=cfg["health_degraded_ms"],
         health_stalled_ms=cfg["health_stalled_ms"],
-        load_report_interval_ms=cfg["load_report_interval_ms"])
+        load_report_interval_ms=cfg["load_report_interval_ms"],
+        placer_interval_ms=cfg["placer_interval_ms"],
+        heartbeat_lease_ms=cfg["heartbeat_lease_ms"],
+        pack_queries=cfg["pack_queries"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

@@ -10,10 +10,24 @@ epoch predates ours; the boot-epoch CAS in ServerContext makes epochs
 total-ordered per store). Adoption is itself a CAS, so two racing
 successors cannot both take a query.
 
-Liveness here is epoch-based (single store, one active server at a
+Liveness at BOOT is epoch-based (single store, one active server at a
 time — a successor always boots with a higher epoch). A multi-server
-deployment over the replicated store adds heartbeats on the same
-records; the CAS adoption path is unchanged.
+deployment (the placer, ISSUE 17) adds heartbeats on the same records:
+owners re-stamp ``hb_ms`` every placer tick, survivors adopt through
+:func:`try_adopt_live` only when the lease lapses (or the record was
+explicitly ``offered`` to them by a rebalance), and the CAS adoption
+discipline is unchanged — two racing adopters still converge to one
+owner. Record schema (JSON under ``scheduler/query/<qid>``)::
+
+    {"node": "server-1@host:port",  # owner (or offer target)
+     "epoch": 7,                    # owner's boot epoch (fencing)
+     "hb_ms": 1700000000000,        # last owner heartbeat, wall ms
+     "state": "owned" | "offered",  # offered = rebalance handoff
+     "src": "server-2@..."}         # offering node (offered only)
+
+``hb_ms``/``state`` are additive: records written by older code (or by
+servers running with the placer disarmed) carry neither and keep the
+pure epoch semantics everywhere.
 
 ``QuerySupervisor`` (ISSUE 8) closes the loop the reference leaves
 open ("task distribution: none" — and a dead query stays dead): a
@@ -52,11 +66,42 @@ def node_name(ctx) -> str:
     return f"server-{ctx.server_id}@{ctx.host}:{ctx.port}"
 
 
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+def _owned_record(ctx) -> bytes:
+    return json.dumps({"node": node_name(ctx),
+                       "epoch": ctx.boot_epoch,
+                       "hb_ms": now_ms(),
+                       "state": "owned"}).encode()
+
+
+def owner_heartbeat_age_ms(record: dict | None) -> int | None:
+    """Milliseconds since the owner last heartbeated this record, or
+    None for legacy records that carry no heartbeat (pure epoch
+    liveness)."""
+    if not record:
+        return None
+    hb = record.get("hb_ms")
+    if hb is None:
+        return None
+    return max(0, now_ms() - int(hb))
+
+
+def owner_live(record: dict | None, lease_ms: int) -> bool:
+    """True when the record's owner heartbeated within the lease. A
+    record without hb_ms is NOT live by this test (legacy records fall
+    back to the epoch rule instead)."""
+    age = owner_heartbeat_age_ms(record)
+    return age is not None and age <= int(lease_ms)
+
+
 def record_assignment(ctx, query_id: str) -> None:
     """Unconditionally claim a query for this server (fresh launches:
-    the creating server owns the query)."""
-    value = json.dumps({"node": node_name(ctx),
-                        "epoch": ctx.boot_epoch}).encode()
+    the creating server owns the query). The write carries an implicit
+    heartbeat — the owner was alive at launch."""
+    value = _owned_record(ctx)
     for _ in range(16):
         cur = ctx.config.get(_key(query_id))
         try:
@@ -170,6 +215,111 @@ def _journal_adoption_lost(ctx, query_id: str) -> None:
             epoch=ctx.boot_epoch)
     except Exception:  # noqa: BLE001 — journaling must not block boot
         pass
+
+
+def heartbeat_assignment(ctx, query_id: str) -> bool:
+    """CAS-refresh ``hb_ms`` on a record this node owns. Returns False
+    (without writing) when the record is gone or no longer names this
+    node as owner — the caller lost ownership (e.g. an in-flight
+    rebalance offered the query away) and must not resurrect the
+    record."""
+    me = node_name(ctx)
+    for _ in range(4):
+        cur = ctx.config.get(_key(query_id))
+        if cur is None:
+            return False
+        version, raw = cur
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            return False
+        if rec.get("node") != me or rec.get("state", "owned") != "owned":
+            return False
+        rec["hb_ms"] = now_ms()
+        rec["epoch"] = ctx.boot_epoch
+        try:
+            ctx.config.put(_key(query_id), json.dumps(rec).encode(),
+                           base_version=version)
+            return True
+        except VersionMismatch:
+            continue
+    return False
+
+
+def offer_assignment(ctx, query_id: str, target_node: str) -> bool:
+    """Rebalance handoff: CAS the record from owned-by-me to
+    ``offered`` naming ``target_node``. The offer carries a fresh
+    ``hb_ms`` so the target has one full lease to claim it before any
+    other node may take it through lease lapse; ``epoch`` drops to 0
+    so a plain boot-time ``try_adopt`` can also claim an orphaned
+    offer. Caller must have stopped the local task FIRST — after this
+    write the query has no live owner until someone adopts."""
+    me = node_name(ctx)
+    cur = ctx.config.get(_key(query_id))
+    if cur is None:
+        return False
+    version, raw = cur
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return False
+    if rec.get("node") != me:
+        return False
+    offer = json.dumps({"node": target_node, "epoch": 0,
+                        "hb_ms": now_ms(), "state": "offered",
+                        "src": me}).encode()
+    try:
+        ctx.config.put(_key(query_id), offer, base_version=version)
+        return True
+    except VersionMismatch:
+        return False
+
+
+def try_adopt_live(ctx, query_id: str, lease_ms: int) -> bool:
+    """Runtime (placer) adoption: CAS-claim a query whose owner's
+    heartbeat lapsed past ``lease_ms``, or that was explicitly
+    ``offered`` to this node by a rebalance. Unlike boot-time
+    :func:`try_adopt` this ignores epoch ORDER for heartbeated records
+    — a dead owner may well have booted after us — but a record with a
+    FRESH heartbeat is never taken, whatever its epoch. Legacy records
+    without ``hb_ms`` fall back to the boot epoch rule."""
+    cur = ctx.config.get(_key(query_id))
+    me = node_name(ctx)
+    if cur is None:
+        try:
+            ctx.config.put(_key(query_id), _owned_record(ctx))
+            return True
+        except VersionMismatch:
+            _journal_adoption_lost(ctx, query_id)
+            return False
+    version, raw = cur
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        rec = {"node": "?", "epoch": 0}
+    state = rec.get("state", "owned")
+    if rec.get("node") == me and state == "owned":
+        return False  # already mine; nothing to adopt
+    offered_to_me = state == "offered" and rec.get("node") == me
+    if not offered_to_me:
+        age = owner_heartbeat_age_ms(rec)
+        if age is None:
+            # legacy record: epoch liveness, exactly like boot
+            if int(rec.get("epoch", 0)) >= ctx.boot_epoch:
+                return False
+        elif age <= int(lease_ms):
+            return False  # owner (or offer target) is live
+    try:
+        ctx.config.put(_key(query_id), _owned_record(ctx),
+                       base_version=version)
+        log.info("live-adopted query %s from %s (%s, hb age %sms)",
+                 query_id, rec.get("node"), state,
+                 owner_heartbeat_age_ms(rec))
+        _journal_adoption(ctx, query_id, rec)
+        return True
+    except VersionMismatch:
+        _journal_adoption_lost(ctx, query_id)
+        return False
 
 
 def assignments(ctx) -> dict[str, dict]:
@@ -480,6 +630,20 @@ class QuerySupervisor:
             return
         if fresh.status in (TaskStatus.TERMINATED, TaskStatus.FAILED):
             return  # terminated (or breaker opened) while pending
+        placer = getattr(ctx, "placer", None)
+        if placer is not None and placer.armed:
+            # live-adoption discipline: while pending, a peer may have
+            # adopted this query (our heartbeat lapsed during a long
+            # backoff) or a rebalance may have offered it away —
+            # restarting anyway would make two live owners
+            rec = assignment(ctx, qid)
+            if rec is not None and (
+                    rec.get("node") != node_name(ctx)
+                    or rec.get("state", "owned") != "owned"):
+                log.info("dropping restart of %s: record now names "
+                         "%s (%s)", qid, rec.get("node"),
+                         rec.get("state", "owned"))
+                return
         if not adoption_allowed(ctx, qid):
             # overload: defer like boot adoption — same slot, later due
             with self._lock:

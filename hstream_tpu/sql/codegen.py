@@ -419,6 +419,22 @@ def explain_text(plan: plans.Plan) -> str:
                          "(data x key) when --mesh is set")
         else:
             lines.append(f"MESH: single-chip — {reason}")
+        # co-compile packing eligibility (ISSUE 17c): the typed refusal
+        # reason surfaces here so EXPLAIN answers "why didn't this
+        # query share a lattice" (lazy import: placer pulls scheduler,
+        # which pulls codegen back)
+        from hstream_tpu.placer.packing import (
+            PackRefusal,
+            pack_signature,
+            signature_text,
+        )
+
+        sig = pack_signature(plan)
+        if isinstance(sig, PackRefusal):
+            lines.append(f"PACK: unpackable — {sig.code}: {sig.detail}")
+        else:
+            lines.append("PACK: packable with --pack-queries — "
+                         f"{signature_text(sig)}")
         return "\n".join(lines)
     if isinstance(plan, plans.CreateBySelectPlan):
         return (f"CREATE STREAM {plan.stream} AS\n"

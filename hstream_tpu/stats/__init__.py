@@ -89,6 +89,13 @@ PER_STREAM_COUNTERS = [
     "lock_contention",         # traced-lock acquires that found the
                                # lock taken (locktrace witness armed;
                                # label: lock role name)
+    "placement_decisions",     # placer decisions written onto
+                               # scheduler/query/* — place, adopt, or
+                               # rebalance offer (label: query id)
+    "queries_adopted",         # queries this server claimed live via
+                               # the heartbeat-lease CAS (try_adopt_
+                               # live), boot adoption NOT included
+                               # (label: query id)
 ]
 
 # stream-scoped rate families, in the (name, bucket-widths) tuple
@@ -138,6 +145,10 @@ GAUGES = [
     "mesh_shards",            # per query: key-axis shard count of the
                               # mesh the executor runs on (absent for
                               # single-chip queries), sampled at scrape
+    "placer_node_score",      # per cluster node: the placer's load
+                              # score folded from the node's published
+                              # record (lower = preferred), sampled at
+                              # scrape while node records are fresh
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);

@@ -194,6 +194,76 @@ def load_report_fields(ctx) -> dict:
     }
 
 
+# ---- placer node records (ISSUE 17) ----------------------------------------
+
+# Per-node load records in the CAS-versioned config store, keyed
+# ``cluster/nodes/<node>``. The journal's node_load_report events are
+# per-PROCESS rings — a peer's placer can't read them — so placement
+# runs off these shared records instead: every armed placer publishes
+# its own node's fold each tick, and every placer ranks ALL fresh
+# records when it decides. Same bounded shape as the journal event,
+# plus the placement-eligibility axes (epoch, heartbeat, shed level,
+# fenced flag).
+NODE_RECORD_PREFIX = "cluster/nodes/"
+
+
+def node_record_fields(ctx) -> dict:
+    """The placement view of this node: load_report_fields minus the
+    per-stream ladders (scores don't rank on them), plus eligibility
+    signals."""
+    fields = load_report_fields(ctx)
+    fields.pop("streams", None)
+    fields["ts_ms"] = int(time.time() * 1000)
+    fields["hb_ms"] = fields["ts_ms"]
+    fields["epoch"] = getattr(ctx, "boot_epoch", 0)
+    flow = getattr(ctx, "flow", None)
+    fields["shed_level"] = 0 if flow is None \
+        else int(flow.overload.effective_level())
+    fields["fenced"] = bool(
+        getattr(ctx.store, "fenced_by", None) is not None)
+    return fields
+
+
+def publish_node_record(ctx) -> dict | None:
+    """Write this node's record to ``cluster/nodes/<node>``; the write
+    doubles as the node's cluster-level heartbeat. Read-modify-write
+    CAS (single writer per node, but a racing admin/test write must
+    not wedge the publisher). Returns the published fields, or None
+    when every retry lost."""
+    from hstream_tpu.store.versioned import VersionMismatch
+
+    fields = node_record_fields(ctx)
+    key = NODE_RECORD_PREFIX + fields["node"]
+    value = json.dumps(fields).encode()
+    for _ in range(4):
+        cur = ctx.config.get(key)
+        try:
+            ctx.config.put(key, value,
+                           base_version=None if cur is None else cur[0])
+            return fields
+        except VersionMismatch:
+            continue
+    return None
+
+
+def cluster_node_records(ctx) -> dict[str, dict]:
+    """node name -> last published record, every node that ever
+    published on this store (callers filter by heartbeat age)."""
+    out: dict[str, dict] = {}
+    for key in ctx.config.keys():
+        if not key.startswith(NODE_RECORD_PREFIX):
+            continue
+        cur = ctx.config.get(key)
+        if cur is None:
+            continue
+        try:
+            rec = json.loads(cur[1])
+        except ValueError:
+            continue
+        out[key[len(NODE_RECORD_PREFIX):]] = rec
+    return out
+
+
 # ---- RPC glue --------------------------------------------------------------
 
 

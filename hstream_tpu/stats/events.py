@@ -57,6 +57,11 @@ EVENT_KINDS = [
                          # counts, append-front depth, rss — THE
                          # machine-readable load signal the thousand-
                          # query placer gates on (ROADMAP item 2)
+    "placement_decision",  # the placer wrote a decision onto
+                           # scheduler/query/*: placed a new query,
+                           # live-adopted a lapsed owner's query, or
+                           # offered one away in a rebalance — with
+                           # the machine-readable reason + scores
 ]
 
 

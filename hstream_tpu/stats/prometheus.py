@@ -41,7 +41,9 @@ PREFIX = "hstream"
 # RetraceGuard attribution (a compile observed under a named guard
 # counts against that query/bench scope, not only `_process`).
 QUERY_LABEL_COUNTERS = frozenset({"query_restarts", "snapshot_fallbacks",
-                                  "late_drops", "kernel_recompiles"})
+                                  "late_drops", "kernel_recompiles",
+                                  "placement_decisions",
+                                  "queries_adopted"})
 
 # counters whose label is a closed vocabulary outside both the stream
 # and query namespaces (kernel families): never liveness-filtered
@@ -135,6 +137,14 @@ _HELP = {
                           "(step / close / probe / session)",
     "lock_contention": "traced-lock acquires that found the lock "
                        "taken (lock-order witness armed)",
+    "placement_decisions": "placer decisions written onto "
+                           "scheduler/query/* (place, live adopt, or "
+                           "rebalance offer)",
+    "queries_adopted": "queries claimed live through the heartbeat-"
+                       "lease CAS (boot adoption not included)",
+    "placer_node_score": "placer load score per cluster node folded "
+                         "from its published node record (lower = "
+                         "preferred)",
     "lock_wait_ms": "time spent waiting to acquire each named traced "
                     "lock (lock-order witness armed)",
     "lock_hold_ms": "time each named traced lock was held per "
@@ -279,6 +289,8 @@ def _gauge_label_key(metric: str) -> str:
         return "subscription"
     if metric == "replica_ack_lag":
         return "follower"
+    if metric == "placer_node_score":
+        return "node"
     return "label"
 
 
@@ -422,6 +434,20 @@ def sample_gauges(ctx) -> None:
     from hstream_tpu.stats.cluster import rss_bytes
 
     stats.gauge_set("node_rss_bytes", "", rss_bytes())
+    # placer node scores (ISSUE 17): one gauge series per cluster node
+    # with a fresh published record — the load fold the placement
+    # decisions actually rank on, so an operator can see WHY a node
+    # won. Stale nodes drop off the exposition with their records.
+    placer = getattr(ctx, "placer", None)
+    live_n: set[tuple[str, str]] = set()
+    if placer is not None:
+        try:
+            for node, score in placer.scores().items():
+                stats.gauge_set("placer_node_score", node, score)
+                live_n.add(("placer_node_score", node))
+        except Exception:  # noqa: BLE001 — a closing placer must not
+            pass           # fail the scrape
+    _drop_stale(stats, ("placer_node_score",), live_n)
     front = getattr(ctx, "append_front", None)
     if front is not None:
         try:

@@ -1405,3 +1405,203 @@ def test_interleaving_promotion_vs_append_races():
             f"seed {seed}: witness reported a lock-order cycle"
         LOCKTRACE.disarm()
         FAULTS.disarm()
+
+
+# ---- the placer (ISSUE 17): kill-the-owner adoption, exact results ----------
+
+
+def _placer_cluster(n=3, *, lease_ms=800):
+    """N armed servers over ONE shared mem store: every node runs a
+    placer tick loop, heartbeats its owned queries, and sweeps for
+    lapsed owners — the in-process stand-in for a real cluster."""
+    store = open_store("mem://")
+    nodes = []
+    for _ in range(n):
+        server, ctx = serve(
+            "127.0.0.1", 0, store=store, owns_store=False,
+            placer_interval_ms=100, heartbeat_lease_ms=lease_ms,
+            snapshot_interval_ms=60, load_report_interval_ms=300)
+        nodes.append((server, ctx))
+    return store, nodes
+
+
+def _placer_kill(server, ctx):
+    """Crash-style death: no drop_assignment, no record cleanup — the
+    node's scheduler records simply stop heartbeating, exactly like a
+    SIGKILL'd process over a surviving shared store."""
+    ctx.placer.stop()
+    ctx.supervisor.shutdown()
+    server.stop(grace=0)
+    for task in list(ctx.running_queries.values()):
+        try:
+            task.stop(detach=True)
+        except Exception:  # noqa: BLE001
+            pass
+    ctx.running_queries.clear()
+    ctx.load_reporter.stop()
+
+
+def _placer_owners(nodes, qid, dead):
+    return [i for i, (_s, c) in enumerate(nodes)
+            if i not in dead and qid in c.running_queries]
+
+
+def _sink_final(rows, count_col):
+    """Last-change-wins fold of an EMIT CHANGES sink log: the final
+    count per (key, window). Replayed changes after a snapshot resume
+    overwrite with identical values, so duplicates are invisible —
+    LOST rows are not."""
+    final = {}
+    for r in rows:
+        if "k" in r and count_col in r and "winStart" in r:
+            final[(r["k"], r["winStart"])] = r[count_col]
+    return final
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_placer_kill_owner_adoption_exact(seed):
+    """Kill the node that owns a live query mid-stream: within the
+    heartbeat lease + a few placer ticks EXACTLY ONE survivor adopts
+    it (zero double-owners at every sampled instant), resumes from the
+    snapshot, and the sink's final per-window counts equal a no-fault
+    single-executor run over the identical row sequence."""
+    rng = random.Random(seed)
+    store, nodes = _placer_cluster(3, lease_ms=800)
+    dead: set[int] = set()
+    channels = []
+    try:
+        _s0, c0 = nodes[0]
+        ch0 = grpc.insecure_channel(f"127.0.0.1:{c0.port}")
+        channels.append(ch0)
+        stub0 = HStreamApiStub(ch0)
+        stub0.CreateStream(pb.Stream(stream_name="src"))
+        stub0.ExecuteQuery(pb.CommandQuery(
+            stmt_text="CREATE STREAM snk AS SELECT k, COUNT(*) AS c "
+                      "FROM src GROUP BY k, TUMBLING (INTERVAL 10 "
+                      "SECOND) GRACE BY INTERVAL 0 SECOND "
+                      "EMIT CHANGES;"))
+        qid = c0.persistence.get_queries()[0].query_id
+        assert _wait(lambda: len(_placer_owners(nodes, qid, dead)) == 1,
+                     timeout=15), "query never landed on a node"
+
+        batches = []  # the full seeded row sequence, for the reference
+
+        def append_via(ctx, rows, ts):
+            req = pb.AppendRequest(stream_name="src")
+            for row, t in zip(rows, ts):
+                req.records.append(
+                    rec.build_record(row, publish_time_ms=t))
+            ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+            channels.append(ch)
+            HStreamApiStub(ch).Append(req)
+            batches.append((rows, ts))
+
+        def seeded_batch(w, n):
+            rows = [{"k": rng.choice("abc"), "v": rng.randrange(10)}
+                    for _ in range(n)]
+            ts = [BASE + w * 10_000 + i for i in range(len(rows))]
+            return rows, ts
+
+        # stream a few windows at the initial owner
+        for w in range(3):
+            append_via(c0, *seeded_batch(w, rng.randrange(3, 7)))
+        owner = _placer_owners(nodes, qid, dead)[0]
+        sink_has_rows = lambda: bool(  # noqa: E731
+            _sink_final(_read_chaos_sink(c0, "snk"), "c"))
+        assert _wait(sink_has_rows, timeout=30), \
+            "no output before the kill; scenario degenerate"
+
+        # KILL the owner mid-stream
+        _placer_kill(*nodes[owner])
+        dead.add(owner)
+        survivor_ctx = next(c for i, (_s, c) in enumerate(nodes)
+                            if i not in dead)
+        # rows keep arriving while the query is ownerless
+        for w in range(3, 5):
+            append_via(survivor_ctx, *seeded_batch(w, rng.randrange(3, 7)))
+
+        # exactly one survivor adopts; zero double-owners at EVERY poll
+        deadline = time.time() + 20
+        adopted = False
+        while time.time() < deadline:
+            owners = _placer_owners(nodes, qid, dead)
+            assert len(owners) <= 1, \
+                f"seed {seed}: double owners {owners}"
+            if owners and owners[0] != owner:
+                adopted = True
+                break
+            time.sleep(0.05)
+        assert adopted, f"seed {seed}: no survivor adopted {qid}"
+
+        # drain the tail + close every window, then compare exactly
+        for w in range(5, 7):
+            append_via(survivor_ctx, *seeded_batch(w, rng.randrange(3, 7)))
+        closer = ([{"k": "zz", "v": 0}], [BASE + 90_000])
+        append_via(survivor_ctx, *closer)
+
+        ref_ex, ref_rows = _feed(
+            "SELECT k, COUNT(*) AS c FROM src GROUP BY k, "
+            "TUMBLING (INTERVAL 10 SECOND) GRACE BY INTERVAL 0 SECOND "
+            "EMIT CHANGES;",
+            batches, sample=[{"k": "a", "v": 0}])
+        want = _sink_final(ref_rows, "c")
+        assert want, "reference emitted nothing; scenario degenerate"
+
+        def exact():
+            got = _sink_final(_read_chaos_sink(survivor_ctx, "snk"), "c")
+            return all(got.get(kw) == c for kw, c in want.items())
+
+        assert _wait(exact, timeout=30), (
+            f"seed {seed}: adopted run diverged: "
+            f"{_sink_final(_read_chaos_sink(survivor_ctx, 'snk'), 'c')}"
+            f" != {want}")
+        # the record names the adopter, owned, heartbeating
+        from hstream_tpu.server import scheduler
+        a = scheduler.assignment(survivor_ctx, qid)
+        owner_idx = _placer_owners(nodes, qid, dead)[0]
+        assert a["node"] == scheduler.node_name(nodes[owner_idx][1])
+        assert a["state"] == "owned"
+        assert scheduler.owner_live(a, lease_ms=5000)
+        # ... and the adoption was journaled + counted
+        kinds = [e["kind"] for e in nodes[owner_idx][1].events.query(
+            kind="query_adopted", limit=10)]
+        assert kinds, f"seed {seed}: no query_adopted event"
+    finally:
+        for ch in channels:
+            ch.close()
+        for i, (server, ctx) in enumerate(nodes):
+            if i in dead:
+                continue
+            server.stop(grace=0.1)
+            ctx.shutdown()
+        store.close()
+
+
+def _read_chaos_sink(ctx, stream):
+    from hstream_tpu.common import columnar
+
+    logid = ctx.streams.get_logid(stream)
+    tail = ctx.store.tail_lsn(logid)
+    out = []
+    if not tail:
+        return out
+    r = ctx.store.new_reader()
+    r.set_timeout(0)
+    r.start_reading(logid, 1, tail)
+    while True:
+        items = r.read(256)
+        if not items:
+            break
+        for it in items:
+            if not isinstance(it, DataBatch):
+                continue
+            for p in it.payloads:
+                pr = rec.parse_record(p)
+                crows = columnar.payload_rows(pr.payload)
+                if crows is not None:
+                    out.extend(crows)
+                    continue
+                row = rec.record_to_dict(pr)
+                if row is not None:
+                    out.append(row)
+    return out

@@ -1,0 +1,341 @@
+"""Co-compile query packing (ISSUE 17 satellite 4): compatible queries
+share ONE compiled lattice program — RetraceGuard pins zero recompiles
+for the 2nd..Nth attached member — incompatible plans refuse with a
+typed reason that EXPLAIN surfaces, and demux is exact against
+standalone executor references.
+
+The zero-recompile contract rides the transport's sticky monotone width
+discipline (engine/transport.py): batch widths bucket to pow2 and the
+interned key-id span widens along _BIT_LADDER at most once per rung.
+Tests hold the tagged input width in one pow2 bucket and warm the key
+id span into a ladder rung with headroom, so a new member's fresh ids
+never force a wider encoding — which is exactly the steady-state shape
+discipline the bench gates.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.common.tracing import RetraceGuard
+from hstream_tpu.placer.packing import (
+    PackMemberTask,
+    PackPool,
+    PackRefusal,
+    pack_signature,
+    signature_text,
+)
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server.main import serve
+from hstream_tpu.sql.codegen import explain_text, make_executor, stream_codegen
+from hstream_tpu.store import open_store
+
+BASE = 1_700_000_000_000
+
+CSAS = ("CREATE STREAM {sink} AS SELECT k, COUNT(*) AS {c} FROM src "
+        "GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
+
+
+def _plan(sql):
+    return stream_codegen(sql)
+
+
+# ---- signatures + typed refusals --------------------------------------------
+
+
+def test_compatible_queries_share_a_signature():
+    s1 = pack_signature(_plan(CSAS.format(sink="s1", c="c1")))
+    s2 = pack_signature(_plan(CSAS.format(sink="s2", c="c2")))
+    assert not isinstance(s1, PackRefusal)
+    # aliases differ, the signature does not: renames are member-local
+    assert s1 == s2
+    assert "tumbling" in signature_text(s1)
+    # a different window shape is a different pack
+    s3 = pack_signature(_plan(
+        "CREATE STREAM s3 AS SELECT k, COUNT(*) AS c FROM src "
+        "GROUP BY k, TUMBLING (INTERVAL 20 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;"))
+    assert s3 != s1
+    # ... and so is a different agg set or source stream
+    s4 = pack_signature(_plan(
+        "CREATE STREAM s4 AS SELECT k, SUM(x) AS s FROM src "
+        "GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;"))
+    assert s4 != s1
+
+
+def test_typed_refusals():
+    cases = {
+        "join": ("SELECT s1.x, s2.y FROM s1 INNER JOIN s2 "
+                 "WITHIN (INTERVAL 10 SECOND) ON s1.k = s2.k "
+                 "EMIT CHANGES;"),
+        "stateless": "SELECT k FROM s EMIT CHANGES;",
+        "filter": ("SELECT COUNT(*) FROM s WHERE x > 0 GROUP BY k, "
+                   "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"),
+        "unwindowed": "SELECT COUNT(*) FROM s GROUP BY k EMIT CHANGES;",
+        "session-window": ("SELECT COUNT(*) FROM s GROUP BY k, "
+                           "SESSION (INTERVAL 30 SECOND) EMIT CHANGES;"),
+        "having": ("SELECT k, COUNT(*) AS c FROM s GROUP BY k, "
+                   "TUMBLING (INTERVAL 10 SECOND) "
+                   "HAVING COUNT(*) >= 2 EMIT CHANGES;"),
+        "projection": ("SELECT k, COUNT(*) + 1 AS c FROM s GROUP BY k, "
+                       "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;"),
+        "computed-agg-input": ("SELECT k, SUM(x + 1) AS s FROM s "
+                               "GROUP BY k, TUMBLING (INTERVAL 10 "
+                               "SECOND) EMIT CHANGES;"),
+    }
+    for code, sql in cases.items():
+        out = pack_signature(_plan(sql))
+        assert isinstance(out, PackRefusal), (code, out)
+        assert out.code == code, (code, out)
+
+
+def test_explain_surfaces_pack_verdict():
+    packable = explain_text(_plan(CSAS.format(sink="s1", c="c1")))
+    assert "PACK: packable with --pack-queries" in packable
+    refused = explain_text(_plan(
+        "SELECT COUNT(*) FROM s GROUP BY k, "
+        "SESSION (INTERVAL 30 SECOND) EMIT CHANGES;"))
+    assert "PACK: unpackable — session-window:" in refused
+
+
+# ---- manual pack groups: zero recompiles + exact demux ----------------------
+
+
+def _manual_pool():
+    store = open_store("mem://")
+    ctx = ServerContext(store, owns_store=False)
+    ctx.streams.create_stream("src")
+    return store, ctx, PackPool(ctx, manual=True)
+
+
+def test_second_and_third_member_compile_nothing():
+    """The headline: once the group's lattice is warm, attaching the
+    2nd..Nth compatible query and streaming through it compiles ZERO
+    new XLA executables — N queries, one program, one dispatch chain."""
+    store, ctx, pool = _manual_pool()
+    try:
+        out1, out2, out3 = [], [], []
+        t1 = pool.try_attach("q1", _plan(CSAS.format(sink="s1", c="c1")),
+                             out1.extend)
+        assert isinstance(t1, PackMemberTask)
+        g = pool.member_of("q1")
+        # warm member 1 with 4-row batches anchored at k0 and sweeping
+        # to k33: input cap stays in the width-4 bucket while the key
+        # id span crosses 32 — the 6-bit ladder rung, leaving headroom
+        # for the ids new members will mint
+        for w in range(11):
+            ks = ["k0"] + [f"k{3 * w + i}" for i in (1, 2, 3)]
+            g.feed([{"k": k} for k in ks], BASE + w * 10_000, lsn=10 + w)
+        assert out1, "warm windows must have closed and emitted"
+
+        t2 = pool.try_attach("q2", _plan(CSAS.format(sink="s2", c="c2")),
+                             out2.extend)
+        assert isinstance(t2, PackMemberTask)
+        with RetraceGuard() as guard:
+            # 2 members x 2 rows = tagged width 4: same pow2 bucket
+            for w in range(11, 15):
+                g.feed([{"k": "k1"}, {"k": "k2"}],
+                       BASE + w * 10_000, lsn=100 + w)
+        assert guard.count == 0, \
+            f"2nd member recompiled {guard.count}x"
+        assert out2, "2nd member demuxed no rows"
+
+        t3 = pool.try_attach("q3", _plan(CSAS.format(sink="s3", c="c3")),
+                             out3.extend)
+        with RetraceGuard() as guard:
+            # 3 members x 1 row = tagged width 3, pads into the 4 bucket
+            for w in range(15, 19):
+                g.feed([{"k": "k1"}], BASE + w * 10_000, lsn=200 + w)
+        assert guard.count == 0, \
+            f"3rd member recompiled {guard.count}x"
+        assert out3, "3rd member demuxed no rows"
+
+        st = g.status()
+        assert st["members"] == ["q1", "q2", "q3"]
+        assert st["compiled"] and st["batches"] >= 19
+        # every member rode the SAME executor object
+        assert pool.member_of("q2") is g and pool.member_of("q3") is g
+    finally:
+        ctx.shutdown()
+        store.close()
+
+
+def test_demux_exact_vs_standalone_executors():
+    """Each member's packed output must equal a standalone executor fed
+    the identical row/ts sequence — including its own SELECT-list
+    renames (c1 vs c2)."""
+    store, ctx, pool = _manual_pool()
+    try:
+        p1 = _plan(CSAS.format(sink="s1", c="c1"))
+        p2 = _plan(CSAS.format(sink="s2", c="c2"))
+        out1, out2 = [], []
+        pool.try_attach("q1", p1, out1.extend)
+        pool.try_attach("q2", p2, out2.extend)
+        g = pool.member_of("q1")
+
+        batches = []
+        for w in range(6):
+            rows = [{"k": k} for k in ("a", "b", "a", "c")]
+            batches.append((rows, [BASE + w * 10_000 + i
+                                   for i in range(4)]))
+        for i, (rows, ts) in enumerate(batches):
+            g.feed(rows, ts, lsn=10 + i)
+
+        def reference(plan):
+            ex = make_executor(plan.select,
+                               sample_rows=[{"k": "a"}])
+            out = []
+            for rows, ts in batches:
+                out.extend(ex.process(rows, ts))
+            return out
+
+        key = lambda r: (r.get("winStart"), sorted(r.items()))  # noqa: E731
+        ref1 = reference(p1)
+        assert ref1, "reference emitted nothing; test is vacuous"
+        assert sorted(out1, key=key) == sorted(ref1, key=key)
+        ref2 = reference(p2)
+        assert sorted(out2, key=key) == sorted(ref2, key=key)
+        # the two members' rows really differ only by the rename
+        assert {"c1"} == {k for r in out1 for k in r} - \
+            {"k", "winStart", "winEnd"}
+        assert {"c2"} == {k for r in out2 for k in r} - \
+            {"k", "winStart", "winEnd"}
+    finally:
+        ctx.shutdown()
+        store.close()
+
+
+def test_attach_lsn_gates_late_members_and_detach_tears_down():
+    store, ctx, pool = _manual_pool()
+    try:
+        out1, out2 = [], []
+        pool.try_attach("q1", _plan(CSAS.format(sink="s1", c="c1")),
+                        out1.extend)
+        g = pool.member_of("q1")
+        m1_lsn = g.members["q1"].attach_lsn
+        # rows BEFORE q2 attaches belong to q1 alone
+        g.feed([{"k": "a"}], BASE, lsn=m1_lsn + 1)
+        pool.try_attach("q2", _plan(CSAS.format(sink="s2", c="c2")),
+                        out2.extend)
+        g.members["q2"].attach_lsn = m1_lsn + 5  # attach point
+        g.feed([{"k": "a"}], BASE + 1, lsn=m1_lsn + 2)   # pre-attach
+        g.feed([{"k": "a"}], BASE + 2, lsn=m1_lsn + 6)   # post-attach
+        g.feed([{"k": "z"}], BASE + 30_000, lsn=m1_lsn + 7)  # closer
+        c1 = max(r["c1"] for r in out1 if r["k"] == "a")
+        c2 = max(r["c2"] for r in out2 if r["k"] == "a")
+        assert c1 == 3      # saw all three rows
+        assert c2 == 1      # only the post-attach row
+        # detach: the pool forgets members; the group dies with the last
+        pool.detach("q1")
+        assert pool.member_of("q1") is None
+        assert g.status()["members"] == ["q2"]
+        pool.detach("q2")
+        assert pool.groups == {} and pool.member_of("q2") is None
+    finally:
+        ctx.shutdown()
+        store.close()
+
+
+# ---- server-level packing: --pack-queries end to end ------------------------
+
+
+def _wait(cond, timeout=20.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_server_packs_compatible_queries_one_group():
+    server, ctx = serve("127.0.0.1", 0, "mem://", pack_queries=True)
+    ch = None
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+        stub = HStreamApiStub(ch)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk1", c="c1")))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk2", c="c2")))
+        tasks = list(ctx.running_queries.values())
+        assert len(tasks) == 2
+        assert all(getattr(t, "packed", False) for t in tasks)
+        # ONE group, both members — the admin surface agrees
+        packs = ctx.pack_pool.status()
+        assert len(packs) == 1 and len(packs[0]["members"]) == 2
+        resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+            command="placer", args=rec.dict_to_struct({})))
+        import json
+
+        assert len(json.loads(resp.result)["packs"]) == 1
+
+        # stream rows through the shared runner; both sinks materialize
+        req = pb.AppendRequest(stream_name="src")
+        for i, t in enumerate([BASE, BASE + 1, BASE + 2]):
+            req.records.append(rec.build_record({"k": "a", "i": i},
+                                                publish_time_ms=t))
+        stub.Append(req)
+        closer = pb.AppendRequest(stream_name="src")
+        closer.records.append(rec.build_record(
+            {"k": "zz"}, publish_time_ms=BASE + 30_000))
+        stub.Append(closer)
+
+        def emitted(stream, col):
+            rows = _read_sink(ctx, stream)
+            return [r for r in rows if r.get("k") == "a"
+                    and r.get(col) == 3]
+
+        assert _wait(lambda: emitted("snk1", "c1") and
+                     emitted("snk2", "c2"), timeout=30), \
+            (_read_sink(ctx, "snk1"), _read_sink(ctx, "snk2"))
+        # terminating one member leaves the other streaming
+        qids = sorted(ctx.running_queries)
+        stub.TerminateQueries(pb.TerminateQueriesRequest(
+            query_ids=[qids[0]]))
+        assert _wait(lambda: len(ctx.pack_pool.status()) == 1 and
+                     len(ctx.pack_pool.status()[0]["members"]) == 1)
+    finally:
+        if ch is not None:
+            ch.close()
+        server.stop(grace=0.5)
+        ctx.shutdown()
+
+
+def _read_sink(ctx, stream):
+    from hstream_tpu.common import columnar
+    from hstream_tpu.store.api import DataBatch
+
+    logid = ctx.streams.get_logid(stream)
+    tail = ctx.store.tail_lsn(logid)
+    out = []
+    if not tail:
+        return out
+    r = ctx.store.new_reader()
+    r.set_timeout(0)
+    r.start_reading(logid, 1, tail)
+    while True:
+        items = r.read(256)
+        if not items:
+            break
+        for it in items:
+            if not isinstance(it, DataBatch):
+                continue
+            for p in it.payloads:
+                pr = rec.parse_record(p)
+                crows = columnar.payload_rows(pr.payload)
+                if crows is not None:
+                    out.extend(crows)
+                    continue
+                row = rec.record_to_dict(pr)
+                if row is not None:
+                    out.append(row)
+    return out

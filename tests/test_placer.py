@@ -1,0 +1,466 @@
+"""The placer (ISSUE 17): scoring units, heartbeat/offer/adopt CAS
+discipline, and the seeded in-process 3-node acceptance — least-loaded
+placement, live failover adoption of a killed node's queries, rebalance
+on load skew — over ONE shared in-memory store (the CI placer smoke).
+
+Runtime-budgeted: fast knobs everywhere (placer tick 100ms, heartbeat
+lease <= 1s), whole file well under 60s on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import grpc
+
+from hstream_tpu.common import records as rec
+from hstream_tpu.placer.score import (
+    SKIP_FENCED,
+    SKIP_SHEDDING,
+    SKIP_STALE,
+    SKIP_STALLED,
+    node_score,
+    rank_nodes,
+    skip_reason,
+)
+from hstream_tpu.proto import api_pb2 as pb
+from hstream_tpu.proto.rpc import HStreamApiStub
+from hstream_tpu.server import scheduler
+from hstream_tpu.server.context import ServerContext
+from hstream_tpu.server.main import serve
+from hstream_tpu.server.persistence import TaskStatus
+from hstream_tpu.store import open_store
+
+BASE = 1_700_000_000_000
+NOW = 10**14  # fixed "now" for pure scoring units
+
+
+def _wait(cond, timeout=20.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+# ---- scoring units ----------------------------------------------------------
+
+
+def test_node_score_folds_load_axes():
+    idle = {"hb_ms": NOW}
+    assert node_score(idle) == 0.0
+    loaded = {"hb_ms": NOW, "running_queries": 3, "append_inflight": 2,
+              "append_front": {"in_flight": 1},
+              "arena_pending_batches": 2,
+              "dispatch_p99_ms": 7.5, "rss_bytes": 2_000_000_000,
+              "health": {"degraded": 1, "stalled": 0}}
+    # 3*10 + 2*2 + 1*2 + 2*2 + 7.5 + 2 + 10 = 59.5
+    assert node_score(loaded) == 59.5
+    # a stalled query dominates any realistic load delta
+    assert node_score({"health": {"stalled": 1}}) == 100.0
+
+
+def test_skip_reasons_cover_ineligible_nodes():
+    lease = 1000
+    ok = {"hb_ms": NOW}
+    assert skip_reason(ok, lease_ms=lease, now_ms=NOW) is None
+    stale = {"hb_ms": NOW - 5000}
+    assert skip_reason(stale, lease_ms=lease, now_ms=NOW) == SKIP_STALE
+    fenced = {"hb_ms": NOW, "fenced": True}
+    assert skip_reason(fenced, lease_ms=lease, now_ms=NOW) == SKIP_FENCED
+    shed = {"hb_ms": NOW, "shed_level": 2}
+    assert skip_reason(shed, lease_ms=lease, now_ms=NOW) == SKIP_SHEDDING
+    sick = {"hb_ms": NOW, "health": {"stalled": 2}}
+    assert skip_reason(sick, lease_ms=lease, now_ms=NOW) == SKIP_STALLED
+    # precedence: a stale record is skipped as stale even if also fenced
+    assert skip_reason({"hb_ms": NOW - 5000, "fenced": True},
+                       lease_ms=lease, now_ms=NOW) == SKIP_STALE
+
+
+def test_rank_nodes_is_deterministic_with_name_tiebreak():
+    records = {
+        "b-node": {"hb_ms": NOW, "running_queries": 1},
+        "a-node": {"hb_ms": NOW, "running_queries": 1},
+        "c-busy": {"hb_ms": NOW, "running_queries": 5},
+        "d-dead": {"hb_ms": NOW - 10_000},
+    }
+    ranked, skipped = rank_nodes(records, lease_ms=1000, now_ms=NOW)
+    # equal scores tie-break on the node name; the busy node ranks last
+    assert [n for _s, n in ranked] == ["a-node", "b-node", "c-busy"]
+    assert skipped == {"d-dead": SKIP_STALE}
+
+
+# ---- heartbeat / offer / live-adopt CAS units -------------------------------
+
+
+def _two_contexts():
+    """Two bare server contexts over ONE store + persistence: ctx2
+    boots later, so its epoch is strictly higher."""
+    store = open_store("mem://")
+    ctx1 = ServerContext(store, port=1111, owns_store=False)
+    ctx2 = ServerContext(store, persistence=ctx1.persistence, port=2222,
+                         owns_store=False)
+    assert ctx2.boot_epoch > ctx1.boot_epoch
+    return store, ctx1, ctx2
+
+
+def _rewrite_hb(ctx, qid, hb_ms):
+    """Backdate a record's heartbeat (simulates a crashed owner whose
+    last stamp is old)."""
+    key = "scheduler/query/" + qid
+    version, raw = ctx.config.get(key)
+    record = json.loads(raw)
+    record["hb_ms"] = hb_ms
+    ctx.config.put(key, json.dumps(record).encode(), base_version=version)
+
+
+def test_record_assignment_carries_heartbeat_and_refreshes():
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        scheduler.record_assignment(ctx1, "q1")
+        a = scheduler.assignment(ctx1, "q1")
+        assert a["state"] == "owned"
+        assert scheduler.owner_live(a, lease_ms=10_000)
+        _rewrite_hb(ctx1, "q1", scheduler.now_ms() - 60_000)
+        assert not scheduler.owner_live(scheduler.assignment(ctx1, "q1"),
+                                        lease_ms=10_000)
+        # the owner's heartbeat refreshes the stamp...
+        assert scheduler.heartbeat_assignment(ctx1, "q1")
+        assert scheduler.owner_live(scheduler.assignment(ctx1, "q1"),
+                                    lease_ms=10_000)
+        # ...but a non-owner's heartbeat refuses without writing
+        before = scheduler.assignment(ctx1, "q1")
+        assert not scheduler.heartbeat_assignment(ctx2, "q1")
+        assert scheduler.assignment(ctx1, "q1") == before
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_try_adopt_live_respects_fresh_heartbeat_whatever_epoch():
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        scheduler.record_assignment(ctx1, "q1")
+        # ctx2's epoch is higher, but ctx1's heartbeat is FRESH: the
+        # live-peer regression pin — never adopted, never re-placed
+        assert not scheduler.try_adopt_live(ctx2, "q1", lease_ms=5000)
+        assert scheduler.assignment(ctx2, "q1")["node"] \
+            == scheduler.node_name(ctx1)
+        # once the lease lapses the survivor takes it
+        _rewrite_hb(ctx1, "q1", scheduler.now_ms() - 60_000)
+        assert scheduler.try_adopt_live(ctx2, "q1", lease_ms=5000)
+        a = scheduler.assignment(ctx2, "q1")
+        assert a["node"] == scheduler.node_name(ctx2)
+        assert a["state"] == "owned"
+        # adoption journaled with the machine-readable previous owner
+        kinds = [e["kind"] for e in ctx2.events.query(limit=100)]
+        assert "query_adopted" in kinds
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_try_adopt_live_claims_missing_and_offered_records():
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        # missing record: claimable outright
+        assert scheduler.try_adopt_live(ctx2, "orphan", lease_ms=5000)
+        assert scheduler.assignment(ctx2, "orphan")["node"] \
+            == scheduler.node_name(ctx2)
+        # an offer names its target: the target claims it despite the
+        # offer's fresh heartbeat; anyone else must wait out the lease
+        scheduler.record_assignment(ctx1, "q2")
+        assert scheduler.offer_assignment(
+            ctx1, "q2", scheduler.node_name(ctx2))
+        offered = scheduler.assignment(ctx1, "q2")
+        assert offered["state"] == "offered"
+        assert offered["epoch"] == 0
+        assert offered["src"] == scheduler.node_name(ctx1)
+        assert not scheduler.try_adopt_live(ctx1, "q2", lease_ms=5000)
+        assert scheduler.try_adopt_live(ctx2, "q2", lease_ms=5000)
+        assert scheduler.assignment(ctx2, "q2")["state"] == "owned"
+        # already mine: nothing to adopt
+        assert not scheduler.try_adopt_live(ctx2, "q2", lease_ms=5000)
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_try_adopt_live_legacy_records_keep_epoch_rule():
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        legacy_hi = json.dumps({"node": "server-9@x:1",
+                                "epoch": ctx2.boot_epoch + 5}).encode()
+        ctx1.config.put("scheduler/query/qh", legacy_hi)
+        assert not scheduler.try_adopt_live(ctx2, "qh", lease_ms=100)
+        legacy_lo = json.dumps({"node": "server-9@x:1",
+                                "epoch": 1}).encode()
+        ctx2.config.put("scheduler/query/ql", legacy_lo)
+        assert scheduler.try_adopt_live(ctx2, "ql", lease_ms=100)
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_boot_try_adopt_stays_epoch_only():
+    """The disarmed/boot path is untouched by heartbeats: a stale-epoch
+    record is adopted even though its launch-time hb_ms is fresh."""
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        scheduler.record_assignment(ctx1, "q1")  # fresh hb_ms
+        assert scheduler.try_adopt(ctx2, "q1")
+        assert scheduler.assignment(ctx2, "q1")["node"] \
+            == scheduler.node_name(ctx2)
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+# ---- ownerless-query health gap (ISSUE 17 satellite 2) ----------------------
+
+
+def test_dead_owner_heartbeat_lapse_reads_stalled_dead():
+    from hstream_tpu.server.health import evaluate_query
+    from hstream_tpu.server.persistence import QueryInfo
+
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        ctx1.persistence.insert_query(QueryInfo(
+            query_id="q1", sql="select", created_time_ms=BASE,
+            query_type="stream", status=TaskStatus.CREATED, sink="s"))
+        ctx1.persistence.set_query_status("q1", TaskStatus.RUNNING)
+        scheduler.record_assignment(ctx1, "q1")
+        # regression pin: owned by a LIVE peer (fresh heartbeat) ->
+        # healthy from ctx2's point of view, never re-placed
+        h = evaluate_query(ctx2, "q1")
+        assert h["verdict"] == "OK"
+        assert not scheduler.try_adopt_live(
+            ctx2, "q1", lease_ms=ctx2.heartbeat_lease_ms)
+        # the owner dies silently: its heartbeat lapses
+        _rewrite_hb(ctx1, "q1", scheduler.now_ms() - 60_000)
+        h = evaluate_query(ctx2, "q1")
+        assert h["verdict"] == "STALLED"
+        assert "dead" in h["reasons"]
+        stalled = [e for e in ctx2.events.query(limit=100)
+                   if e["kind"] == "query_stalled"]
+        assert stalled and "dead" in stalled[-1]["reasons"]
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+# ---- in-process armed clusters ----------------------------------------------
+
+
+def _cluster(n=3, *, interval_ms=100, lease_ms=800, store=None):
+    """N armed servers over ONE shared in-memory store: the in-process
+    multi-node model (boot epochs total-ordered by the config CAS)."""
+    store = store or open_store("mem://")
+    nodes = []
+    for _ in range(n):
+        server, ctx = serve(
+            "127.0.0.1", 0, store=store, owns_store=False,
+            placer_interval_ms=interval_ms, heartbeat_lease_ms=lease_ms,
+            snapshot_interval_ms=60, load_report_interval_ms=300)
+        nodes.append((server, ctx))
+    return store, nodes
+
+
+def _teardown(store, nodes, dead=()):
+    for i, (server, ctx) in enumerate(nodes):
+        if i in dead:
+            continue
+        server.stop(grace=0.1)
+        ctx.shutdown()
+    store.close()
+
+
+def _kill(server, ctx):
+    """Crash a node: no drop_assignment, no record cleanup — its
+    scheduler records simply stop heartbeating."""
+    ctx.placer.stop()
+    ctx.supervisor.shutdown()
+    server.stop(grace=0)
+    for task in list(ctx.running_queries.values()):
+        try:
+            task.stop(detach=True)
+        except Exception:  # noqa: BLE001
+            pass
+    ctx.running_queries.clear()
+    ctx.load_reporter.stop()
+
+
+def _owners(nodes, qid, dead=()):
+    return [i for i, (_s, c) in enumerate(nodes)
+            if i not in dead and qid in c.running_queries]
+
+
+def _stub(ctx):
+    ch = grpc.insecure_channel(f"127.0.0.1:{ctx.port}")
+    return ch, HStreamApiStub(ch)
+
+
+def _admin(stub, cmd, **kw):
+    resp = stub.SendAdminCommand(pb.AdminCommandRequest(
+        command=cmd, args=rec.dict_to_struct(kw)))
+    return json.loads(resp.result)
+
+
+CSAS = ("CREATE STREAM {sink} AS SELECT k, COUNT(*) AS c FROM {src} "
+        "GROUP BY k, TUMBLING (INTERVAL 10 SECOND) "
+        "GRACE BY INTERVAL 0 SECOND EMIT CHANGES;")
+
+
+def test_cluster_places_on_least_loaded_and_exposes_scores():
+    store, nodes = _cluster(3)
+    ch = None
+    try:
+        _s0, c0 = nodes[0]
+        ch, stub = _stub(c0)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        # every node must have published a record before placement ranks
+        assert _wait(lambda: len(c0.placer.scores()) == 3, timeout=10)
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk", src="src")))
+        # the offer/adopt pipeline lands the query on exactly one node
+        assert _wait(lambda: len(_owners(nodes, _qid(c0))) == 1,
+                     timeout=15)
+        qid = _qid(c0)
+        st = _admin(stub, "placer")
+        assert st["armed"] and len(st["nodes"]) == 3
+        decision = next(d for d in st["decisions"]
+                        if d["action"] == "place")
+        assert decision["reason"] == "least_loaded"
+        assert decision["query"] == qid
+        assert set(decision["scores"]) == set(st["nodes"])
+        # the winner really was ranked least-loaded at decision time
+        assert decision["target"] \
+            == min(sorted(decision["scores"]),
+                   key=lambda n: (decision["scores"][n], n))
+        rec_ = st["placements"][qid]
+        assert rec_["state"] == "owned"
+        # counters + gauge on the exporter (ISSUE 17 satellite 1)
+        from hstream_tpu.stats.prometheus import render_metrics
+
+        text = render_metrics(c0)
+        assert "placement_decisions" in text
+        assert 'placer_node_score{node="' in text
+    finally:
+        if ch is not None:
+            ch.close()
+        _teardown(store, nodes)
+
+
+def _qid(ctx):
+    qs = [q.query_id for q in ctx.persistence.get_queries()]
+    assert len(qs) == 1
+    return qs[0]
+
+
+def test_full_lifecycle_place_kill_adopt_rebalance():
+    """The acceptance scenario in one run: queries placed, the owner
+    killed, a survivor adopts within the lease, and a later boot pulls
+    load over through a rebalance offer."""
+    store, nodes = _cluster(1, lease_ms=800)
+    ch = None
+    dead = set()
+    try:
+        _s0, c0 = nodes[0]
+        ch, stub = _stub(c0)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        # two queries on the lone node: both place locally
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk1", src="src")))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk2", src="src")))
+        assert _wait(lambda: len(c0.running_queries) == 2, timeout=15)
+
+        # REBALANCE: two fresh idle peers boot; the skew (2 vs 0) must
+        # move exactly one query — hysteresis keeps the other local
+        for _ in range(2):
+            server, ctx = serve(
+                "127.0.0.1", 0, store=store, owns_store=False,
+                placer_interval_ms=100, heartbeat_lease_ms=800,
+                snapshot_interval_ms=60, load_report_interval_ms=300)
+            nodes.append((server, ctx))
+        qids = sorted(q.query_id for q in c0.persistence.get_queries())
+        assert _wait(
+            lambda: sorted(len(_owners(nodes, q)) for q in qids) == [1, 1]
+            and len(c0.running_queries) == 1, timeout=20)
+        moved = next(q for q in qids if q not in c0.running_queries)
+        move = next(d for d in c0.placer.status()["decisions"]
+                    if d["action"] == "rebalance")
+        assert move["reason"] == "load_skew"
+        assert move["query"] == moved
+
+        # KILL the adopter: the moved query's records stop heartbeating
+        owner_idx = _owners(nodes, moved)[0]
+        assert owner_idx != 0
+        _kill(*nodes[owner_idx])
+        dead.add(owner_idx)
+        t_kill = time.time()
+        assert _wait(lambda: len(_owners(nodes, moved, dead)) == 1,
+                     timeout=15)
+        adopt_s = time.time() - t_kill
+        # adoption waits out the lease, then lands within a few ticks
+        assert adopt_s < 10, f"adoption took {adopt_s:.1f}s"
+        # never two owners; the record names the adopter, owned
+        survivors = _owners(nodes, moved, dead)
+        assert len(survivors) == 1
+        a = scheduler.assignment(c0, moved)
+        adopter_ctx = nodes[survivors[0]][1]
+        assert a["node"] == scheduler.node_name(adopter_ctx)
+        assert a["state"] == "owned"
+        # the adopter journaled + counted the adoption
+        adopts = [d for d in adopter_ctx.placer.status()["decisions"]
+                  if d["action"] == "adopt"]
+        assert adopts and adopts[-1]["reason"] in ("lease_lapsed",
+                                                   "offered")
+    finally:
+        if ch is not None:
+            ch.close()
+        _teardown(store, nodes, dead)
+
+
+def test_restarting_owner_defers_to_live_adopter():
+    """Boot-time guard (armed): a server restarting with a HIGHER boot
+    epoch must not snatch back a query a live peer owns and heartbeats
+    — resume_persisted skips it even though pure epoch order says
+    adopt."""
+    store, nodes = _cluster(1, lease_ms=5000)
+    ch = None
+    try:
+        _s0, c0 = nodes[0]
+        ch, stub = _stub(c0)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk", src="src")))
+        assert _wait(lambda: len(c0.running_queries) == 1, timeout=15)
+        qid = _qid(c0)
+        # a second armed server boots on the same store (higher epoch):
+        # the record's heartbeat is fresh, so it must stand down
+        server2, ctx2 = serve(
+            "127.0.0.1", 0, store=store, owns_store=False,
+            placer_interval_ms=100, heartbeat_lease_ms=5000,
+            load_report_interval_ms=300)
+        nodes.append((server2, ctx2))
+        assert ctx2.boot_epoch > c0.boot_epoch
+        assert qid not in ctx2.running_queries
+        # and its sweeps keep refusing while the owner heartbeats
+        time.sleep(0.6)
+        assert qid not in ctx2.running_queries
+        assert scheduler.assignment(ctx2, qid)["node"] \
+            == scheduler.node_name(c0)
+        assert qid in c0.running_queries
+    finally:
+        if ch is not None:
+            ch.close()
+        _teardown(store, nodes)
