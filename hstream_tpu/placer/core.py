@@ -8,7 +8,13 @@ tick:
      every peer's ranking reads;
   2. **heartbeat** the ``scheduler/query/*`` records of queries this
      node owns (running tasks AND tasks the supervisor is about to
-     restart — a backoff wait must not read as death to peers);
+     restart — a backoff wait must not read as death to peers); a
+     heartbeat that finds the record gone or naming another owner
+     means ownership was LOST (a delayed tick let the lease lapse and
+     a peer live-adopted) — the loser self-fences: it stops the local
+     task crash-style (no snapshot, no status write — the adopter's
+     state is the live one) and cancels its supervisor slot, so a
+     slow-but-alive owner can never stay a second live owner;
   3. **adopt** queries whose owner's heartbeat lapsed past the lease,
      or that were ``offered`` to this node by a rebalance or a remote
      placement — CAS first (``scheduler.try_adopt_live``: racing
@@ -67,6 +73,19 @@ class Placer:
         self.interval_ms = interval_ms
         self.lease_ms = int(lease_ms)
         self.armed = bool(interval_ms) and int(interval_ms) > 0
+        if self.armed:
+            # an owner heartbeats once per tick: a lease shorter than
+            # a few ticks makes every healthy owner look dead between
+            # heartbeats — continuous spurious live-adoptions. Clamp
+            # rather than reject so a misconfigured node still boots.
+            min_lease = 3 * int(interval_ms)
+            if self.lease_ms < min_lease:
+                log.warning(
+                    "heartbeat lease %dms < 3x placer interval %dms; "
+                    "clamping lease to %dms so a delayed tick cannot "
+                    "read as owner death", self.lease_ms,
+                    int(interval_ms), min_lease)
+                self.lease_ms = min_lease
         # bound by the servicer once handlers exist (same resume path
         # the supervisor and RestartQuery use)
         self.resume_fn = None
@@ -129,7 +148,40 @@ class Placer:
             st = sup.status()
             owned.update(st.get("pending", {}))
         for qid in sorted(owned):
-            scheduler.heartbeat_assignment(ctx, qid)
+            if not scheduler.heartbeat_assignment(ctx, qid):
+                # definitive ownership loss (record gone, re-owned by
+                # a peer, or offered away): keeping the local task
+                # running would make two live owners
+                self._self_fence(qid)
+
+    def _self_fence(self, qid: str) -> None:
+        """Stop the local task for a query this node no longer owns.
+        Crash-mode stop: no final snapshot and no status write — the
+        new owner already resumed from the last snapshot and writes
+        its own; a stale snapshot or a TERMINATED status from the
+        fenced loser would corrupt the adopter's run. The supervisor
+        slot is cancelled first so a pending restart cannot resurrect
+        the query after the fence."""
+        ctx = self.ctx
+        rec = scheduler.assignment(ctx, qid)
+        sup = getattr(ctx, "supervisor", None)
+        if sup is not None:
+            sup.cancel(qid)
+        task = ctx.running_queries.pop(qid, None)
+        if task is not None:
+            try:
+                if getattr(task, "packed", False):
+                    task.stop()  # detach from the shared lattice
+                else:
+                    task.stop(crash=True)
+            except Exception:  # noqa: BLE001 — the fence must stand
+                log.exception("self-fence stop of %s failed", qid)
+        log.warning("self-fenced query %s: record now names %s (%s)",
+                    qid, (rec or {}).get("node"),
+                    "missing" if rec is None
+                    else rec.get("state", "owned"))
+        self._decide("self_fence", qid, target=(rec or {}).get("node"),
+                     reason="ownership_lost")
 
     def _adopt_sweep(self) -> None:
         from hstream_tpu.server.persistence import TaskStatus
@@ -147,13 +199,32 @@ class Placer:
             offered_to_me = (rec is not None and state == "offered"
                              and rec.get("node") == me)
             if info.status == TaskStatus.CREATED and not offered_to_me:
-                continue  # mid-launch on its creator; not ours to take
+                # mid-launch on its creator — UNLESS the record's
+                # heartbeat already lapsed: the creator died before
+                # the task registered, or a remote placement's target
+                # died before claiming its offer. Any survivor may
+                # rescue those; otherwise an orphaned CREATED query
+                # would wait for a server reboot while the cluster is
+                # live. No record at all (the creator is writing it
+                # right now) stays off-limits.
+                age = scheduler.owner_heartbeat_age_ms(rec)
+                if age is None or age <= self.lease_ms:
+                    continue
             if info.status not in (TaskStatus.CREATED,
                                    TaskStatus.RUNNING):
                 continue
             if rec is not None and rec.get("node") == me \
                     and state == "owned":
                 continue  # already mine: the supervisor's domain
+            if rec is not None and rec.get("node") != me \
+                    and "hb_ms" not in rec:
+                # legacy record (written by a server with the placer
+                # disarmed): its owner never heartbeats, so it may be
+                # alive RIGHT NOW — the live sweep must not apply the
+                # boot-epoch rule to it. Boot-time adoption (where a
+                # lower epoch really does mean a dead predecessor)
+                # remains the rescue path for these.
+                continue
             if not scheduler.adoption_allowed(ctx, qid):
                 continue
             if not scheduler.try_adopt_live(ctx, qid, self.lease_ms):

@@ -461,14 +461,19 @@ class PackPool:
         except Exception:  # noqa: BLE001 — source gone mid-launch
             return PackRefusal("source", "source stream unavailable")
         with self._lock:
+            # lookup + attach under ONE pool-lock hold (lock order
+            # pool -> group, same as detach): a concurrent detach of
+            # the group's last member cannot pop the group and stop
+            # its runner between our lookup and the attach, which
+            # would strand this member on a torn-down group that
+            # feeds nobody
             group = self.groups.get(sig)
             created = group is None
             if created:
                 group = PackGroup(self.ctx, sig,
                                   batch_capacity=self.batch_capacity)
                 self.groups[sig] = group
-        member = group.attach(qid, sel, sink, attach_lsn)
-        with self._lock:
+            member = group.attach(qid, sel, sink, attach_lsn)
             self._by_qid[qid] = group
             if created and not self.manual:
                 runner = _PackRunner(self.ctx, group)

@@ -216,6 +216,10 @@ class ServerContext:
             else heartbeat_lease_ms)
         self.placer = Placer(self, interval_ms=placer_interval_ms,
                              lease_ms=self.heartbeat_lease_ms)
+        # the placer clamps a lease shorter than 3 ticks (a healthy
+        # owner must never look dead between heartbeats); health and
+        # the boot-time live-peer guard must judge by the SAME lease
+        self.heartbeat_lease_ms = self.placer.lease_ms
         # co-compile packing: compatible queries share one executor /
         # one dispatch (ISSUE 17c); opt-in via --pack-queries
         self.pack_pool = PackPool(self) if pack_queries else None

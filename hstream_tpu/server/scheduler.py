@@ -71,10 +71,18 @@ def now_ms() -> int:
 
 
 def _owned_record(ctx) -> bytes:
-    return json.dumps({"node": node_name(ctx),
-                       "epoch": ctx.boot_epoch,
-                       "hb_ms": now_ms(),
-                       "state": "owned"}).encode()
+    """Armed servers stamp ``hb_ms``/``state``; a server with the
+    placer disarmed writes the legacy two-field record instead — it
+    will never refresh a heartbeat, and a stamp it can't refresh would
+    read as a lapsed lease to every armed peer after ``lease_ms``
+    (rolling placer enablement would live-adopt queries whose disarmed
+    owner is alive and running)."""
+    record = {"node": node_name(ctx), "epoch": ctx.boot_epoch}
+    placer = getattr(ctx, "placer", None)
+    if placer is not None and placer.armed:
+        record["hb_ms"] = now_ms()
+        record["state"] = "owned"
+    return json.dumps(record).encode()
 
 
 def owner_heartbeat_age_ms(record: dict | None) -> int | None:
@@ -99,8 +107,9 @@ def owner_live(record: dict | None, lease_ms: int) -> bool:
 
 def record_assignment(ctx, query_id: str) -> None:
     """Unconditionally claim a query for this server (fresh launches:
-    the creating server owns the query). The write carries an implicit
-    heartbeat — the owner was alive at launch."""
+    the creating server owns the query). Armed, the write carries an
+    implicit heartbeat — the owner was alive at launch; disarmed it is
+    a legacy epoch-only record."""
     value = _owned_record(ctx)
     for _ in range(16):
         cur = ctx.config.get(_key(query_id))
@@ -151,10 +160,12 @@ def adoption_allowed(ctx, query_id: str) -> bool:
 
 def try_adopt(ctx, query_id: str) -> bool:
     """CAS-claim an unowned or dead-owner query at boot. True = this
-    server now owns it and should resume it."""
+    server now owns it and should resume it. The claim record follows
+    :func:`_owned_record`: armed servers stamp a heartbeat immediately
+    (a boot-adopted query must read as live to peers before the first
+    placer tick), disarmed servers write the legacy epoch record."""
     cur = ctx.config.get(_key(query_id))
-    mine = json.dumps({"node": node_name(ctx),
-                       "epoch": ctx.boot_epoch}).encode()
+    mine = _owned_record(ctx)
     if cur is None:
         try:
             ctx.config.put(_key(query_id), mine)
@@ -219,10 +230,13 @@ def _journal_adoption_lost(ctx, query_id: str) -> None:
 
 def heartbeat_assignment(ctx, query_id: str) -> bool:
     """CAS-refresh ``hb_ms`` on a record this node owns. Returns False
-    (without writing) when the record is gone or no longer names this
-    node as owner — the caller lost ownership (e.g. an in-flight
-    rebalance offered the query away) and must not resurrect the
-    record."""
+    (without writing) ONLY when the record is gone or no longer names
+    this node as owner — the caller definitively lost ownership (a
+    peer live-adopted it, or an in-flight rebalance offered it away),
+    must not resurrect the record, and must self-fence the local task.
+    Transient CAS contention is NOT ownership loss: after the retries
+    the last read still named this node, so the caller keeps running
+    and the next tick refreshes the stamp."""
     me = node_name(ctx)
     for _ in range(4):
         cur = ctx.config.get(_key(query_id))
@@ -243,7 +257,9 @@ def heartbeat_assignment(ctx, query_id: str) -> bool:
             return True
         except VersionMismatch:
             continue
-    return False
+    log.warning("heartbeat CAS for %s kept losing; still owned at "
+                "last read, retrying next tick", query_id)
+    return True
 
 
 def offer_assignment(ctx, query_id: str, target_node: str) -> bool:
@@ -659,13 +675,23 @@ class QuerySupervisor:
             return
         try:
             resume(info)
-            ctx.persistence.set_query_status(qid, TaskStatus.RUNNING)
         except Exception as e:  # noqa: BLE001 — a failed restart is
             # another death: backoff doubles, the breaker counts it
             log.exception("supervised restart of %s failed", qid)
             self.note_death(info, e)
             return
         with self._lock:
+            # the resumed task may ALREADY have died and opened the
+            # breaker (a fault fatal on the first chunk): the breaker
+            # writes FAILED under this lock, so checking + writing
+            # RUNNING under the same hold totally orders the two —
+            # RUNNING can never clobber the breaker's FAILED status
+            if qid in self._breaker_open:
+                return
+            try:
+                ctx.persistence.set_query_status(qid, TaskStatus.RUNNING)
+            except Exception:  # noqa: BLE001 — the task IS running;
+                pass           # status catches up on the next write
             self.restarts += 1
         log.info("supervisor restarted query %s (attempt %d)", qid,
                  attempt)

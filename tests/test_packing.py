@@ -115,6 +115,50 @@ def _manual_pool():
     return store, ctx, PackPool(ctx, manual=True)
 
 
+def test_attach_never_lands_on_a_torn_down_group():
+    """Regression pin (review): try_attach holds the pool lock across
+    lookup+attach, so a concurrent detach of the group's last member
+    can never pop the group (and stop its runner) between the two —
+    which would strand the new member on a torn-down group that feeds
+    nobody. Invariant: right after attach, the member's group IS the
+    pool's registered group for its signature."""
+    import threading
+
+    store, ctx, pool = _manual_pool()
+    try:
+        plan_churn = _plan(CSAS.format(sink="sc", c="c"))
+        plan_main = _plan(CSAS.format(sink="sq", c="c"))
+        sink = lambda rows: None  # noqa: E731
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                pool.try_attach(f"churn-{i}", plan_churn, sink)
+                pool.detach(f"churn-{i}")
+                i += 1
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for i in range(300):
+                task = pool.try_attach(f"q-{i}", plan_main, sink)
+                assert isinstance(task, PackMemberTask)
+                # while q-i is attached the group cannot empty, so a
+                # registered-group mismatch means attach raced a
+                # teardown
+                assert pool.member_of(f"q-{i}") is task.group
+                assert pool.groups.get(task.group.sig) is task.group
+                assert f"q-{i}" in task.group.members
+                pool.detach(f"q-{i}")
+        finally:
+            stop.set()
+            t.join(timeout=10)
+    finally:
+        ctx.shutdown()
+        store.close()
+
+
 def test_second_and_third_member_compile_nothing():
     """The headline: once the group's lattice is warm, attaching the
     2nd..Nth compatible query and streaming through it compiles ZERO

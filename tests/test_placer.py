@@ -97,11 +97,15 @@ def test_rank_nodes_is_deterministic_with_name_tiebreak():
 
 def _two_contexts():
     """Two bare server contexts over ONE store + persistence: ctx2
-    boots later, so its epoch is strictly higher."""
+    boots later, so its epoch is strictly higher. Both placers are
+    ARMED (their records carry heartbeats — a disarmed server writes
+    legacy epoch-only records) but never started: no background ticks,
+    tests drive the stages directly."""
     store = open_store("mem://")
-    ctx1 = ServerContext(store, port=1111, owns_store=False)
+    ctx1 = ServerContext(store, port=1111, owns_store=False,
+                         placer_interval_ms=100)
     ctx2 = ServerContext(store, persistence=ctx1.persistence, port=2222,
-                         owns_store=False)
+                         owns_store=False, placer_interval_ms=100)
     assert ctx2.boot_epoch > ctx1.boot_epoch
     return store, ctx1, ctx2
 
@@ -220,6 +224,110 @@ def test_boot_try_adopt_stays_epoch_only():
     finally:
         ctx2.shutdown()
         ctx1.shutdown()
+        store.close()
+
+
+def test_disarmed_server_writes_legacy_record():
+    """A server with the placer disarmed writes the legacy two-field
+    record: it will never refresh a heartbeat, and a launch-time stamp
+    it can't refresh would read as a lapsed lease to every armed peer
+    one lease later — live-adopting a query whose disarmed owner is
+    alive and running (rolling placer enablement)."""
+    store = open_store("mem://")
+    ctx1 = ServerContext(store, port=1111, owns_store=False)  # disarmed
+    try:
+        scheduler.record_assignment(ctx1, "q1")
+        a = scheduler.assignment(ctx1, "q1")
+        assert "hb_ms" not in a and "state" not in a
+        # never judged by the lease: health/adoption fall back to the
+        # pure epoch rule instead of misreading a stale stamp
+        assert scheduler.owner_heartbeat_age_ms(a) is None
+        assert not scheduler.owner_live(a, lease_ms=10_000)
+    finally:
+        ctx1.shutdown()
+        store.close()
+
+
+def test_adopt_sweep_never_takes_a_live_disarmed_peers_query():
+    """The LIVE sweep must not apply the boot-epoch rule to a legacy
+    record: its (disarmed) owner never heartbeats, so a lower epoch
+    does not mean it is dead — only boot-time adoption (where the
+    predecessor on the same store really is gone) may claim it."""
+    from hstream_tpu.server.persistence import QueryInfo
+
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        ctx1.persistence.insert_query(QueryInfo(
+            query_id="q1", sql="select", created_time_ms=BASE,
+            query_type="stream", status=TaskStatus.CREATED, sink="s"))
+        ctx1.persistence.set_query_status("q1", TaskStatus.RUNNING)
+        legacy = json.dumps({"node": "server-9@x:1",
+                             "epoch": 1}).encode()
+        ctx1.config.put("scheduler/query/q1", legacy)
+        ctx2.placer._adopt_sweep()  # epoch 1 << ctx2's, still skipped
+        assert scheduler.assignment(ctx2, "q1")["node"] == "server-9@x:1"
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_orphaned_created_query_rescued_after_lease_lapse():
+    """place_for_launch's offer names a target that dies before
+    claiming: once the offer's heartbeat lapses, ANY survivor's sweep
+    rescues the CREATED query — it must not wait for a server reboot
+    while the cluster is live."""
+    from hstream_tpu.server.persistence import QueryInfo
+
+    store, ctx1, ctx2 = _two_contexts()
+    try:
+        ctx1.persistence.insert_query(QueryInfo(
+            query_id="q1", sql="select", created_time_ms=BASE,
+            query_type="stream", status=TaskStatus.CREATED, sink="s"))
+        offer = {"node": "server-9@x:1", "epoch": 0,
+                 "hb_ms": scheduler.now_ms(), "state": "offered",
+                 "src": scheduler.node_name(ctx1)}
+        ctx1.config.put("scheduler/query/q1",
+                        json.dumps(offer).encode())
+        # offer FRESH: the query stays the target's to claim
+        ctx2.placer._adopt_sweep()
+        assert scheduler.assignment(ctx2, "q1")["node"] == "server-9@x:1"
+        # the target died without claiming: its offer lapses
+        _rewrite_hb(ctx2, "q1", scheduler.now_ms() - 60_000)
+        ctx2.placer._adopt_sweep()
+        a = scheduler.assignment(ctx2, "q1")
+        assert a["node"] == scheduler.node_name(ctx2)
+        assert a["state"] == "owned"
+        adopts = [d for d in ctx2.placer.status()["decisions"]
+                  if d["action"] == "adopt"]
+        assert adopts and adopts[-1]["query"] == "q1"
+    finally:
+        ctx2.shutdown()
+        ctx1.shutdown()
+        store.close()
+
+
+def test_lease_clamped_to_three_ticks():
+    """An interval larger than the lease would make every healthy
+    owner look dead between heartbeats (continuous spurious
+    adoptions); the placer clamps, and health judges the SAME lease."""
+    from hstream_tpu.placer.core import Placer
+
+    store = open_store("mem://")
+    ctx = ServerContext(store, port=1111, owns_store=False,
+                        placer_interval_ms=5000,
+                        heartbeat_lease_ms=1000)
+    try:
+        assert ctx.placer.lease_ms == 15_000
+        assert ctx.heartbeat_lease_ms == 15_000
+        # disarmed: no clamp — the lease is never consulted
+        assert Placer(None, interval_ms=None,
+                      lease_ms=1000).lease_ms == 1000
+        # a sane config is left alone
+        assert Placer(None, interval_ms=100,
+                      lease_ms=800).lease_ms == 800
+    finally:
+        ctx.shutdown()
         store.close()
 
 
@@ -460,6 +568,71 @@ def test_restarting_owner_defers_to_live_adopter():
         assert scheduler.assignment(ctx2, qid)["node"] \
             == scheduler.node_name(c0)
         assert qid in c0.running_queries
+    finally:
+        if ch is not None:
+            ch.close()
+        _teardown(store, nodes)
+
+
+def _cas_put(ctx, key, value):
+    from hstream_tpu.store.versioned import VersionMismatch
+
+    for _ in range(64):
+        cur = ctx.config.get(key)
+        try:
+            ctx.config.put(key, value,
+                           base_version=None if cur is None else cur[0])
+            return True
+        except VersionMismatch:
+            continue
+    return False
+
+
+def test_owner_self_fences_when_ownership_lost():
+    """A slow-but-alive owner whose record was taken (a delayed tick
+    let the lease lapse and a peer live-adopted) must STOP its local
+    task — its next heartbeat sees the loss and self-fences, so there
+    are never two live owners emitting results."""
+    store, nodes = _cluster(1, lease_ms=800)
+    ch = None
+    try:
+        _s0, c0 = nodes[0]
+        ch, stub = _stub(c0)
+        stub.CreateStream(pb.Stream(stream_name="src"))
+        stub.ExecuteQuery(pb.CommandQuery(
+            stmt_text=CSAS.format(sink="snk", src="src")))
+        assert _wait(lambda: len(c0.running_queries) == 1, timeout=15)
+        qid = _qid(c0)
+        key = "scheduler/query/" + qid
+        # a "peer" steals the record — exactly what try_adopt_live
+        # writes — and keeps its heartbeat FRESH while we wait, so
+        # c0's sweep cannot legitimately take the query back
+        thief = {"node": "server-99@x:1", "epoch": 999,
+                 "state": "owned"}
+
+        def fenced():
+            # wait on the journaled decision — it lands AFTER the pop
+            # and the (potentially slow) crash-style task stop
+            _cas_put(c0, key, json.dumps(
+                dict(thief, hb_ms=scheduler.now_ms())).encode())
+            return any(d["action"] == "self_fence" and d["query"] == qid
+                       for d in c0.placer.status()["decisions"])
+
+        assert _cas_put(c0, key, json.dumps(
+            dict(thief, hb_ms=scheduler.now_ms())).encode())
+        assert _wait(fenced, timeout=10)
+        assert qid not in c0.running_queries
+        # crash-style fence: status stays RUNNING (the new owner's to
+        # manage), no snapshot/status write raced the adopter, and the
+        # thief's record stands untouched
+        assert c0.persistence.get_query(qid).status == TaskStatus.RUNNING
+        assert scheduler.assignment(c0, qid)["node"] == "server-99@x:1"
+        fence = next(d for d in c0.placer.status()["decisions"]
+                     if d["action"] == "self_fence")
+        assert fence["reason"] == "ownership_lost"
+        # the fenced loser stays fenced while the record is live
+        time.sleep(0.3)
+        assert qid not in c0.running_queries
     finally:
         if ch is not None:
             ch.close()

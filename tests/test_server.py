@@ -106,13 +106,18 @@ def test_push_query_end_to_end(server_stub):
                 [{"city": "sf", "temp": 1.0}, {"city": "sf", "temp": 2.0},
                  {"city": "la", "temp": 3.0}],
                 [BASE, BASE + 100, BASE + 200])
+    def _seen():
+        # wait for BOTH cities: a window's rows may stream back in
+        # separate chunks, so the la row can trail the sf row
+        return (any(r.get("city") == "sf" and r.get("c") == 2
+                    for r in got)
+                and any(r.get("city") == "la" and r.get("c") == 1
+                        for r in got))
+
     deadline = time.time() + 30
-    while time.time() < deadline:
-        if any(r.get("city") == "sf" and r.get("c") == 2 for r in got):
-            break
+    while time.time() < deadline and not _seen():
         time.sleep(0.2)
-    assert any(r.get("city") == "sf" and r.get("c") == 2 for r in got), got
-    assert any(r.get("city") == "la" and r.get("c") == 1 for r in got)
+    assert _seen(), got
     # terminate all push queries; the consumer loop must end
     stub.TerminateQueries(pb.TerminateQueriesRequest(all=True))
     t.join(15)
