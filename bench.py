@@ -228,6 +228,35 @@ def measure_freshness(feed, drain, batches: int) -> dict:
     }
 
 
+def measure_device_cost(ex, run_batches) -> dict:
+    """Device cost plane (ISSUE 18): a short pass with the device-time
+    sampler armed at rate 1 (every dispatch fenced + timed), run AFTER
+    the timed region so the fences never tax the headline numbers,
+    then the exact live HBM bytes per executor plane — the bench
+    record of the kernel_device_ms / device_arena_bytes series."""
+    from hstream_tpu.stats.devicecost import DEVICE_TIME
+
+    DEVICE_TIME.reset()
+    DEVICE_TIME.arm(1)
+    try:
+        run_batches()
+        pct = DEVICE_TIME.percentiles()
+    finally:
+        DEVICE_TIME.disarm()
+        DEVICE_TIME.reset()
+    fn = getattr(ex, "device_plane_bytes", None)
+    planes = fn() if fn is not None else {}
+    return {
+        "device_time_ms": {
+            fam: {"p50": round(v["p50"], 3), "p99": round(v["p99"], 3),
+                  "samples": v["count"]}
+            for fam, v in sorted(pct.items())},
+        "hbm_bytes": {"total": int(sum(planes.values())),
+                      "planes": {k: int(v)
+                                 for k, v in sorted(planes.items())}},
+    }
+
+
 @functools.lru_cache(maxsize=1)
 def _rtt_step():
     """Memoized ping kernel: the jit used to be built inside
@@ -291,9 +320,21 @@ def bench_config2_hop_multi() -> dict:
     rows = len(ex.drain_closed())
     force(ex)
     dt = time.perf_counter() - t0
+
+    def _armed_batches():
+        for _ in range(8):
+            kids_, ts_, cols_ = src.next()
+            pipe.submit(kids_, ts_, {"v": cols_["temp"]})
+        pipe.flush()
+        ex.drain_closed()
+        ex.block_until_ready()
+
+    device_cost = measure_device_cost(ex, _armed_batches)
     pipe.close()
     return {"events_per_sec": round(meas * BATCH / dt),
-            "emitted_rows": rows}
+            "emitted_rows": rows,
+            "device_time_ms": device_cost["device_time_ms"],
+            "hbm_bytes": device_cost["hbm_bytes"]}
 
 
 def _session_quantile_executor():
@@ -384,6 +425,17 @@ def bench_config4_session_quantile() -> dict:
     # prior sessions — each batch samples)
     best["freshness_ms"] = measure_freshness(
         lambda b: feed(ex, b0 + b), ex.drain_closed, 20)
+    b0 += 20
+
+    def _armed_batches():
+        for b in range(8):
+            feed(ex, b0 + b)
+        ex.drain_closed()
+        ex.block_until_ready()
+
+    device_cost = measure_device_cost(ex, _armed_batches)
+    best["device_time_ms"] = device_cost["device_time_ms"]
+    best["hbm_bytes"] = device_cost["hbm_bytes"]
     # the retained host engine on the same feed, for the r05 lineage
     # (3 batches only — it is ~10x slower; scaled to eps)
     exh = _session_quantile_executor()
@@ -501,6 +553,16 @@ def bench_config5_join_view() -> dict:
 
     best["freshness_ms"] = measure_freshness(
         _join_feed, ex.flush_changes, 16)
+
+    def _armed_batches():
+        for b in range(8):
+            _join_feed(16 + b)
+        ex.flush_changes()
+        ex.block_until_ready()
+
+    device_cost = measure_device_cost(ex, _armed_batches)
+    best["device_time_ms"] = device_cost["device_time_ms"]
+    best["hbm_bytes"] = device_cost["hbm_bytes"]
     best.update(bench_changelog_decode())
     return best
 
@@ -1079,6 +1141,15 @@ def main() -> None:
     staged = ex.stage_columnar(*src.next())
     wire_bpe = tp.wire_bytes(staged.combo, staged.cap) / staged.cap
 
+    def _headline_armed_batches():
+        for _ in range(8):
+            pipe.submit(*src.next())
+        pipe.flush()
+        ex.drain_closed()
+        ex.block_until_ready()
+
+    device_cost = measure_device_cost(ex, _headline_armed_batches)
+
     result = {
         "metric": "events_per_sec",
         "value": round(eps),
@@ -1097,6 +1168,10 @@ def main() -> None:
         "total_events": len(runs) * MEASURE_BATCHES * BATCH,
         "emitted_rows": emitted_rows,  # across all 3 runs
         "freshness_ms": freshness,
+        # device cost plane (ISSUE 18): fenced per-dispatch device time
+        # (sampler rate 1, post-timed-region pass) + exact arena bytes
+        "device_time_ms": device_cost["device_time_ms"],
+        "hbm_bytes": device_cost["hbm_bytes"],
         "p99_window_close_ms": (round(p99_close, 2)
                                 if p99_close is not None else None),
         "p50_window_close_ms": (round(float(np.percentile(close_ms, 50)),
@@ -1536,10 +1611,30 @@ def smoke_main() -> None:
     from hstream_tpu.common.locktrace import LOCKTRACE
 
     assert not LOCKTRACE.active, "smoke must run witness-disarmed"
-    tumbling = _smoke_run(_smoke_tumbling_config)
-    join = _smoke_run(_smoke_join_config)
-    session = _smoke_run(_smoke_session_config)
-    server_columnar = _smoke_server_columnar()
+    # device-time sampler contract (ISSUE 18), both directions: a
+    # DISARMED run must record ZERO sampler state (the one-attribute-
+    # read + one-branch disarmed path, like the lock witness), and the
+    # main gates below then run with the sampler ARMED at rate 1 —
+    # every dispatch fenced + timed — and must still compile nothing
+    # (block_until_ready is a sync, never a trace)
+    from hstream_tpu.stats.devicecost import DEVICE_TIME
+
+    assert not DEVICE_TIME.active, "smoke must start sampler-disarmed"
+    disarmed_probe = _smoke_run(_smoke_tumbling_config, batches=10)
+    ds = DEVICE_TIME.state()
+    sampler_disarmed_state = (sum(ds["counts"].values())
+                              + sum(ds["samples"].values()))
+    DEVICE_TIME.arm(1)
+    try:
+        tumbling = _smoke_run(_smoke_tumbling_config)
+        join = _smoke_run(_smoke_join_config)
+        session = _smoke_run(_smoke_session_config)
+        server_columnar = _smoke_server_columnar()
+    finally:
+        armed = DEVICE_TIME.state()
+        sampler_armed_samples = sum(armed["samples"].values())
+        DEVICE_TIME.disarm()
+        DEVICE_TIME.reset()
     sharded = _smoke_sharded_subprocess()
     sharded_join = int(sharded.get("sharded_join_recompiles", -1))
     sharded_session = int(sharded.get("sharded_session_recompiles", -1))
@@ -1561,11 +1656,15 @@ def smoke_main() -> None:
         "sharded_devices": sharded.get("devices"),
         "locktrace_disarmed_edges": lock_edges,
         "locktrace_disarmed_locks": lock_state,
+        "sampler_disarmed_probe_recompiles": disarmed_probe,
+        "sampler_disarmed_state": sampler_disarmed_state,
+        "sampler_armed_samples": sampler_armed_samples,
         "batches": 50,
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))
-    if tumbling or join or session or server_columnar or sharded_bad:
+    if tumbling or join or session or server_columnar or sharded_bad \
+            or disarmed_probe:
         print("# retrace gate FAILED: steady-state batches compiled "
               "new XLA executables", flush=True)
         sys.exit(1)
@@ -1573,6 +1672,15 @@ def smoke_main() -> None:
         print("# locktrace gate FAILED: the DISARMED witness recorded "
               "state — the one-branch disarmed contract broke",
               flush=True)
+        sys.exit(1)
+    if sampler_disarmed_state:
+        print("# device-time gate FAILED: the DISARMED sampler "
+              "recorded state — the one-branch disarmed contract "
+              "broke", flush=True)
+        sys.exit(1)
+    if sampler_armed_samples == 0:
+        print("# device-time gate FAILED: the rate-1 armed sampler "
+              "recorded no device-time samples", flush=True)
         sys.exit(1)
 
 

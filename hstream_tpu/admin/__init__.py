@@ -140,6 +140,46 @@ def cmd_health(stub, args) -> list[dict]:
             for h in rows]
 
 
+def cmd_programs(stub, args) -> list[dict]:
+    """Compiled-program inventory (ISSUE 18): one row per resident
+    executable with XLA cost-analysis columns; --json dumps the raw
+    summary + rows."""
+    out = _admin(stub, "programs")
+    data = out[0] if out else {}
+    if getattr(args, "json", False):
+        import json
+
+        print(json.dumps(data, indent=2, sort_keys=True))
+        return []
+    return [{"shape_key": r.get("shape_key"),
+             "family": r.get("family") or "-",
+             "name": (r.get("name") or "")[:40],
+             "compiles": r.get("compiles"),
+             "compile_ms": round(r.get("compile_ms") or 0.0, 1),
+             "gflops": (round(r["flops"] / 1e9, 3)
+                        if r.get("flops") else "-"),
+             "mbytes_acc": (round(r["bytes_accessed"] / 1e6, 3)
+                            if r.get("bytes_accessed") else "-")}
+            for r in data.get("programs", [])]
+
+
+def cmd_flightrec(stub, args) -> list[dict]:
+    """Flight-recorder bundles (ISSUE 18): with a query id, print the
+    raw postmortem bundles as JSON (pipe to a file); without, the
+    recorder index."""
+    import json
+
+    if args.id:
+        out = _admin(stub, "flightrec", query=args.id)
+        print(json.dumps(out[0] if out else {}, indent=2,
+                         sort_keys=True))
+        return []
+    out = _admin(stub, "flightrec")
+    data = out[0] if out else {}
+    return [{"query": q, "bundles": n}
+            for q, n in sorted((data.get("queries") or {}).items())]
+
+
 def cmd_restart_query(stub, args) -> list[dict]:
     stub.RestartQuery(pb.RestartQueryRequest(id=args.id))
     return [{"restarted": args.id}]
@@ -462,6 +502,19 @@ def main(argv=None) -> int:
                             "STALLED with reasons")
     p.add_argument("id", nargs="?", default=None,
                    help="one query id (default: every query)")
+    p = sub.add_parser("programs",
+                       help="compiled-program inventory: every XLA "
+                            "executable this process compiled, with "
+                            "cost-analysis flops/bytes and compile "
+                            "times")
+    p.add_argument("--json", action="store_true",
+                   help="raw summary + rows as JSON")
+    p = sub.add_parser("flightrec",
+                       help="flight-recorder postmortem bundles "
+                            "captured at STALLED / crash-loop edges")
+    p.add_argument("id", nargs="?", default=None,
+                   help="query id: print its bundles as JSON "
+                        "(default: the recorder index)")
     p = sub.add_parser("restart-query")
     p.add_argument("id")
     p = sub.add_parser("terminate-query")

@@ -36,6 +36,8 @@ import uuid
 import zlib
 from collections import defaultdict, deque, OrderedDict
 
+from hstream_tpu.stats.devicecost import DEVICE_TIME as _DEVICE_TIME
+
 
 class QueryTracer:
     """Bounded per-stage duration rings for one query.
@@ -291,18 +293,40 @@ def current_kernel_family() -> str | None:
 
 
 @contextlib.contextmanager
-def kernel_family(family: str, observer=None):
+def kernel_family(family: str, observer=None, *, ready=None):
     """Scope a kernel dispatch under a family name. When `observer`
     (a callable (family, seconds)) is set, the dispatch's host time
     lands there — the per-family dispatch-time histograms ride this.
-    Cost with no observer: two thread-local attribute writes."""
+    Cost with no observer: two thread-local attribute writes.
+
+    `ready` (ISSUE 18) — a zero-arg callable returning the dispatch's
+    live device values — opts the site into the device-time sampler:
+    on a deterministically sampled dispatch the values are fenced
+    (block-until-ready BEFORE the body drains in-flight work), the
+    body runs, and a second block-until-ready bounds the device
+    execution time into `kernel_device_ms{family}`. Disarmed cost is
+    one attribute read + one branch (the FAULTS / FlowGovernor
+    discipline); the disarmed sampler records zero state."""
     prev = getattr(_family_tls, "name", None)
     _family_tls.name = family
-    t0 = time.perf_counter() if observer is not None else 0.0
+    sampled = (ready is not None and _DEVICE_TIME.active
+               and _DEVICE_TIME.tick(family))
+    if sampled:
+        try:
+            _DEVICE_TIME.fence(ready)
+        except Exception:  # noqa: BLE001 — sampling must never fail
+            sampled = False    # a dispatch
+    t0 = time.perf_counter() \
+        if (observer is not None or sampled) else 0.0
     try:
         yield
     finally:
         _family_tls.name = prev
+        if sampled:
+            try:
+                _DEVICE_TIME.measure(family, ready, t0)
+            except Exception:  # noqa: BLE001 — sampling must never
+                pass           # fail a dispatch
         if observer is not None:
             try:
                 observer(family, time.perf_counter() - t0)

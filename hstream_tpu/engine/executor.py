@@ -323,10 +323,34 @@ class QueryExecutor:
 
         def counted(*args):
             self.close_stats["close_dispatches"] += 1
-            with kernel_family("close", self.dispatch_observer):
-                return fn(*args)
+            res = None
+
+            def _ready():  # the kernel result once the body ran
+                return self.state if res is None else res
+
+            with kernel_family("close", self.dispatch_observer,
+                               ready=_ready):
+                res = fn(*args)
+            return res
 
         return counted
+
+    # ---- device cost plane (ISSUE 18) --------------------------------------
+
+    # contract: dispatches<=0 fetches<=0
+    def _device_values(self):
+        """The executor's live device arrays — the fence/measure target
+        of the device-time sampler (a zero-arg late binding: self.state
+        is REPLACED by every step/close dispatch)."""
+        return self.state
+
+    # contract: dispatches<=0 fetches<=0
+    def device_plane_bytes(self) -> dict[str, int]:
+        """Exact per-plane device bytes of the live lattice state —
+        nbytes metadata reads only, zero dispatches, zero fetches."""
+        from hstream_tpu.stats.devicecost import plane_bytes
+
+        return plane_bytes(self.state)
 
     # contract: dispatches<=1 fetches<=0
     def _run_step(self, cap: int, n: int, key_ids, ts_rel, cols,
@@ -343,7 +367,8 @@ class QueryExecutor:
             self.spec, self.schema, self._filter_expr, combo, cap,
             donate_words=True)
         staged_words = self._device_stage(words)
-        with kernel_family("step", self.dispatch_observer):
+        with kernel_family("step", self.dispatch_observer,
+                           ready=self._device_values):
             self.state = step(self.state, wm_rel, np.int32(n), bases,
                               staged_words)
 
@@ -879,7 +904,8 @@ class QueryExecutor:
         step = lattice.compiled_encoded_step(
             self.spec, self.schema, self._filter_expr, staged.combo,
             staged.cap, donate_words=True)
-        with kernel_family("step", self.dispatch_observer):
+        with kernel_family("step", self.dispatch_observer,
+                           ready=self._device_values):
             self.state = step(self.state, wm_rel, np.int32(staged.n),
                               staged.bases, staged.words)
 

@@ -304,6 +304,16 @@ class _JoinBase:
                                                "block_until_ready"):
             self._inner.block_until_ready()
 
+    # contract: dispatches<=0 fetches<=0
+    def device_plane_bytes(self) -> dict[str, int]:
+        """Device bytes of the inner aggregate's planes, "agg."-
+        prefixed (JoinExecutor extends this with its device stores) —
+        nbytes metadata reads only (ISSUE 18)."""
+        fn = getattr(self._inner, "device_plane_bytes", None)
+        if fn is None:
+            return {}
+        return {f"agg.{k}": v for k, v in fn().items()}
+
 
 class TableJoinExecutor(_JoinBase):
     """Executes `SELECT ... FROM l INNER JOIN TABLE(r) ON ...`.
@@ -553,6 +563,37 @@ class JoinExecutor(_JoinBase):
         stats plane exposes."""
         return self._sharded_dispatches + int(getattr(
             self._inner, "sharded_dispatches", 0) or 0)
+
+    # ---- device cost plane (ISSUE 18) --------------------------------------
+
+    # contract: dispatches<=0 fetches<=0
+    def _device_values(self):
+        """Live device values of the probe plane — the fence/measure
+        target of the device-time sampler (late-bound: stores and the
+        inner state are REPLACED by every probe/fused dispatch)."""
+        dev = self._dev
+        if dev is None:
+            return ()
+        vals = [dev["stores"]["l"], dev["stores"]["r"]]
+        inner_state = getattr(self._inner, "state", None)
+        if inner_state is not None:
+            vals.append(inner_state)
+        return vals
+
+    # contract: dispatches<=0 fetches<=0
+    def device_plane_bytes(self) -> dict[str, int]:
+        """Exact per-plane device bytes: both sides' interval stores
+        ("l."/"r."-prefixed) plus the inner aggregate's lattice planes
+        ("agg."-prefixed) — nbytes metadata reads, zero dispatches."""
+        from hstream_tpu.stats.devicecost import plane_bytes
+
+        out = super().device_plane_bytes()
+        dev = self._dev
+        if dev is not None:
+            for side in ("l", "r"):
+                for k, v in plane_bytes(dev["stores"][side]).items():
+                    out[f"{side}.{k}"] = v
+        return out
 
     # ---- ingest ------------------------------------------------------------
     #
@@ -1608,7 +1649,8 @@ class JoinExecutor(_JoinBase):
         if dev.get("feed") is not None and self._fuse_ok(bts):
             return self._fused_batch(side, other_side, buf, n, cutoff)
         if sjl is not None:
-            with kernel_family("probe", self.dispatch_observer):
+            with kernel_family("probe", self.dispatch_observer,
+                               ready=self._device_values):
                 dev["stores"][side], packed = sjl.probe_insert(
                     side, dev["stores"][side], other, buf, np.int32(n),
                     np.int32(self.within), cutoff,
@@ -1618,7 +1660,8 @@ class JoinExecutor(_JoinBase):
             kern = lattice.join_probe_insert(
                 dev["cap"], bcap, dev["match_cap"], len(lay),
                 len(dev["lay"][other_side]))
-            with kernel_family("probe", self.dispatch_observer):
+            with kernel_family("probe", self.dispatch_observer,
+                               ready=self._device_values):
                 dev["stores"][side], packed = kern(
                     dev["stores"][side], other, buf, np.int32(n),
                     np.int32(self.within), cutoff)
@@ -1697,7 +1740,8 @@ class JoinExecutor(_JoinBase):
         feed, nulls_plan, filter_nulls = dev["feed"][side]
         sjl = dev.get("sjl")
         if sjl is not None:
-            with kernel_family("probe", self.dispatch_observer):
+            with kernel_family("probe", self.dispatch_observer,
+                               ready=self._device_values):
                 dev["stores"][side], inner.state, _total = \
                     sjl.probe_insert_step(
                         side, inner._sharded, dev["stores"][side],
@@ -1714,7 +1758,8 @@ class JoinExecutor(_JoinBase):
                 len(dev["lay"][side]), len(dev["lay"][other_side]),
                 inner.spec, inner.schema, inner._filter_expr, feed,
                 nulls_plan, filter_nulls)
-            with kernel_family("probe", self.dispatch_observer):
+            with kernel_family("probe", self.dispatch_observer,
+                               ready=self._device_values):
                 dev["stores"][side], inner.state, _total = kern(
                     dev["stores"][side], dev["stores"][other_side], buf,
                     np.int32(n), np.int32(self.within), cutoff,

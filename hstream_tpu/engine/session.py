@@ -1708,16 +1708,20 @@ class SessionExecutor:
             # the batch replicates along the key axis; the shard_map
             # wrapper clears the valid bit of records other shards own
             self.sharded_dispatches += 1
-            with kernel_family("session", self.dispatch_observer):
-                return ssl.step(dev["arena"], packed,
-                                np.int32(self.window.gap_ms), close_cut,
-                                np.int32(delta))
+            with kernel_family("session", self.dispatch_observer,
+                               ready=self._device_values):
+                dev["arena"] = ssl.step(dev["arena"], packed,
+                                        np.int32(self.window.gap_ms),
+                                        close_cut, np.int32(delta))
+            return dev["arena"]
         step = lattice.session_step_kernel(
             dev["spec"], self.schema, dev["layout"], dev["cap"], bcap)
-        with kernel_family("session", self.dispatch_observer):
-            return step(dev["arena"], packed,
-                        np.int32(self.window.gap_ms), close_cut,
-                        np.int32(delta))
+        with kernel_family("session", self.dispatch_observer,
+                           ready=self._device_values):
+            dev["arena"] = step(dev["arena"], packed,
+                                np.int32(self.window.gap_ms), close_cut,
+                                np.int32(delta))
+        return dev["arena"]
 
     def _dispatch_segment_merge(self, feed, order, starts, ends,
                                 seg_of_row_sorted, seg_code, seg_t0,
@@ -1741,16 +1745,20 @@ class SessionExecutor:
             # segments replicate along the key axis; the shard_map
             # wrapper rewrites unowned segment codes to the sentinel
             self.sharded_dispatches += 1
-            with kernel_family("session", self.dispatch_observer):
-                return ssl.merge(dev["arena"], seg,
-                                 np.int32(self.window.gap_ms), close_cut,
-                                 np.int32(delta))
+            with kernel_family("session", self.dispatch_observer,
+                               ready=self._device_values):
+                dev["arena"] = ssl.merge(dev["arena"], seg,
+                                         np.int32(self.window.gap_ms),
+                                         close_cut, np.int32(delta))
+            return dev["arena"]
         kern = lattice.session_merge_kernel(dev["spec"], dev["cap"],
                                             len(seg["code"]))
-        with kernel_family("session", self.dispatch_observer):
-            return kern(dev["arena"], seg,
-                        np.int32(self.window.gap_ms), close_cut,
-                        np.int32(delta))
+        with kernel_family("session", self.dispatch_observer,
+                           ready=self._device_values):
+            dev["arena"] = kern(dev["arena"], seg,
+                                np.int32(self.window.gap_ms), close_cut,
+                                np.int32(delta))
+        return dev["arena"]
 
     def _segment_planes(self, vv, order, starts, ends, seg_of_row,
                         seg_code, seg_t0_rel, seg_t1_rel
@@ -2073,13 +2081,26 @@ class SessionExecutor:
                 v = slot[idx[sel == s]]
                 slots[s, :len(v)] = v
             self.sharded_dispatches += 1
-            with kernel_family("close", self.dispatch_observer):
-                return ssl.extract(dev["arena"], slots)
+            res = None
+
+            def _ready():  # the extract result once the body ran
+                return dev["arena"] if res is None else res
+
+            with kernel_family("close", self.dispatch_observer,
+                               ready=_ready):
+                res = ssl.extract(dev["arena"], slots)
+            return res
         slots = lattice.pad_slots(idx)
         kern = lattice.session_extract_kernel(dev["spec"], dev["cap"],
                                               len(slots))
-        with kernel_family("close", self.dispatch_observer):
-            return kern(dev["arena"], slots)
+        res = None
+
+        def _ready():
+            return dev["arena"] if res is None else res
+
+        with kernel_family("close", self.dispatch_observer, ready=_ready):
+            res = kern(dev["arena"], slots)
+        return res
 
     # contract: dispatches<=0 fetches<=1
     def drain_closed(self) -> list[dict[str, Any]]:
@@ -2131,6 +2152,26 @@ class SessionExecutor:
             import jax
 
             jax.block_until_ready(self._dev["arena"])
+
+    # ---- device cost plane (ISSUE 18) ----------------------------------
+
+    # contract: dispatches<=0 fetches<=0
+    def _device_values(self):
+        """Late-bound handle for the device-time sampler: the arena dict
+        after the dispatch under measurement replaced it."""
+        dev = self._dev
+        return dev["arena"] if dev is not None else ()
+
+    # contract: dispatches<=0 fetches<=0
+    def device_plane_bytes(self) -> dict[str, int]:
+        """Exact live device bytes per arena plane (host-mode: empty —
+        the numpy mirrors are not device-resident)."""
+        from hstream_tpu.stats.devicecost import plane_bytes
+
+        dev = self._dev
+        if dev is None:
+            return {}
+        return plane_bytes(dev["arena"])
 
     @staticmethod
     def _flatten_sharded_extract(packed: np.ndarray,

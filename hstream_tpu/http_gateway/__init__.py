@@ -32,6 +32,9 @@ Routes (JSON in/out unless noted):
   POST   /queries/<id>/restart
   GET    /queries/<id>/health         OK/DEGRADED/STALLED rollup
   GET    /queries/<id>/trace          span ring, Chrome trace JSON
+  GET    /queries/<id>/flightrec      flight-recorder postmortem bundles
+  GET    /programs                    compiled-program inventory +
+                                      XLA cost analysis
   GET    /views | GET /views/<name> (pull query) | DELETE /views/<name>
   GET    /connectors | POST /connectors {"config": sql} | DELETE .../<id>
   GET    /nodes
@@ -292,6 +295,14 @@ class Gateway:
             if m and method == "GET":
                 # the query's span ring as Chrome trace-event JSON
                 return 200, self._admin("trace-spans", scope=m.group(1))
+            m = re.fullmatch(r"/queries/([^/]+)/flightrec", path)
+            if m and method == "GET":
+                # flight-recorder postmortem bundles (ISSUE 18) — kept
+                # past query deletion (404 only when none were captured)
+                return 200, self._admin("flightrec", query=m.group(1))
+            if path == "/programs" and method == "GET":
+                # compiled-program inventory with XLA cost analysis
+                return 200, self._admin("programs")
 
             if path == "/views" and method == "GET":
                 out = stub.ListViews(pb.ListViewsRequest())
@@ -541,6 +552,13 @@ SWAGGER = {
         "/queries/{id}/trace": {
             "get": {"summary": "span ring as Chrome trace-event JSON "
                                "(needs --trace-sample > 0)"}},
+        "/queries/{id}/flightrec": {
+            "get": {"summary": "flight-recorder postmortem bundles "
+                               "(captured at STALLED / crash-loop "
+                               "edges; survive query deletion)"}},
+        "/programs": {
+            "get": {"summary": "compiled-program inventory with XLA "
+                               "cost-analysis flops/bytes"}},
         "/views": {"get": {"summary": "list views"}},
         "/views/{name}": {"get": {"summary": "pull-query the view"},
                           "delete": {"summary": "drop view"}},

@@ -3,7 +3,8 @@
 The record is the bounded ``node_record_fields`` shape every armed
 placer publishes to ``cluster/nodes/<node>`` each tick (the same axes
 ``NodeStatsReport`` and the ``node_load_report`` journal event carry:
-rss, append-front depth, running queries, dispatch p99, health counts).
+rss, device HBM bytes, append-front depth, running queries, dispatch
+p99, health counts).
 Lower score = preferred. The fold is deliberately simple and DOCUMENTED
 (README "Placement & failover adoption"); determinism matters more than
 cleverness — two placers ranking the same records must pick the same
@@ -23,6 +24,11 @@ W_APPEND_FRONT = 2.0
 W_ARENA_PENDING = 2.0
 W_DISPATCH_P99_MS = 1.0
 W_RSS_GB = 1.0
+# device HBM is the scarce axis on an accelerator host: a GB of live
+# arena bytes costs 5x a GB of host rss (ISSUE 18 — the record carries
+# device_hbm_bytes from the HBM accounting plane; nodes without device
+# executors report 0 and the term vanishes)
+W_HBM_GB = 5.0
 W_DEGRADED = 10.0
 W_STALLED = 100.0
 
@@ -45,6 +51,7 @@ def node_score(record: dict) -> float:
             record.get("arena_pending_batches", 0))
         + W_DISPATCH_P99_MS * float(record.get("dispatch_p99_ms") or 0.0)
         + W_RSS_GB * float(record.get("rss_bytes", 0)) / 1e9
+        + W_HBM_GB * float(record.get("device_hbm_bytes", 0)) / 1e9
         + W_DEGRADED * float(health.get("degraded", 0))
         + W_STALLED * float(health.get("stalled", 0)), 3)
 

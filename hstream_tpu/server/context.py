@@ -49,6 +49,7 @@ class ServerContext:
                  placer_interval_ms: float | None = None,
                  heartbeat_lease_ms: float | None = None,
                  pack_queries: bool = False,
+                 device_time_sample: int = 0,
                  owns_store: bool = True):
         self.store = store
         # in-process multi-node clusters share ONE store across several
@@ -105,6 +106,23 @@ class ServerContext:
         from hstream_tpu.common.tracing import SpanCollector
 
         self.tracing = SpanCollector(sample_rate=trace_sample)
+        # device cost plane (ISSUE 18): the compiled-program inventory
+        # hooks the process-wide compile funnel (idempotent), and the
+        # per-dispatch device-time sampler observes into this holder —
+        # armed only when --device-time-sample > 0 (disarmed cost: one
+        # attribute read + one branch per kernel_family scope)
+        from hstream_tpu.stats.devicecost import DEVICE_TIME, PROGRAMS
+
+        PROGRAMS.install()
+        DEVICE_TIME.add_sink(self.stats)
+        self.device_time_sample = max(int(device_time_sample), 0)
+        if self.device_time_sample > 0:
+            DEVICE_TIME.arm(self.device_time_sample)
+        # flight recorder (ISSUE 18): postmortem bundles captured at
+        # the STALLED / crash-loop edges, surviving query deletion
+        from hstream_tpu.server.flightrec import FlightRecorder
+
+        self.flightrec = FlightRecorder(self)
         # per-query health plane (ISSUE 13): progress memory + verdict
         # transitions behind GET /queries/<id>/health, admin health,
         # and the query_health_level gauge

@@ -1346,6 +1346,30 @@ class HStreamApiServicer:
                 while key in out:
                     key = f"{key}+"
                 out[key] = r
+        elif cmd == "programs":
+            # compiled-program inventory (ISSUE 18): every executable
+            # the compile funnel produced, with XLA cost-analysis rows
+            # (`admin programs`, GET /programs)
+            from hstream_tpu.stats.devicecost import PROGRAMS
+
+            out = {"summary": PROGRAMS.summary(),
+                   "programs": PROGRAMS.rows()}
+        elif cmd == "flightrec":
+            # flight-recorder bundles (ISSUE 18): the postmortem black
+            # box for a distressed query (`admin flightrec <id>`,
+            # GET /queries/<id>/flightrec); no query id -> the index
+            flightrec = getattr(ctx, "flightrec", None)
+            qid = str(args.get("query") or "")
+            if flightrec is None:
+                raise ServerError("flight recorder unavailable")
+            if not qid:
+                out = flightrec.summary()
+            else:
+                bundles = flightrec.bundles(qid)
+                if not bundles:
+                    raise ServerError(
+                        f"no flight-recorder bundles for query {qid!r}")
+                out = {"query": qid, "bundles": bundles}
         elif cmd == "trace-spans":
             # one scope's span ring as Chrome trace-event JSON
             # (GET /queries/<id>/trace, `admin trace --spans`)

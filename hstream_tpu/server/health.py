@@ -247,6 +247,12 @@ def evaluate_query(ctx, qid: str, *, now_ms: float | None = None,
                 prev_verdict=prev)
         except Exception:  # noqa: BLE001 — journaling is best-effort
             pass
+        # the black box (ISSUE 18): snapshot the postmortem bundle at
+        # the SAME edge the distress signal journals on — exactly once
+        # per STALLED episode, with the verdict it already computed
+        rec = getattr(ctx, "flightrec", None)
+        if rec is not None:
+            rec.snapshot(qid, trigger="query_stalled", health=out)
     stats = getattr(ctx, "stats", None)
     if stats is not None:
         try:

@@ -62,6 +62,7 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           placer_interval_ms: float | None = None,
           heartbeat_lease_ms: float | None = None,
           pack_queries: bool = False,
+          device_time_sample: int = 0,
           owns_store: bool = True
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
@@ -102,6 +103,7 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         placer_interval_ms=placer_interval_ms,
                         heartbeat_lease_ms=heartbeat_lease_ms,
                         pack_queries=pack_queries,
+                        device_time_sample=device_time_sample,
                         owns_store=owns_store)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
@@ -273,6 +275,14 @@ def _parse_args(argv):
                          "whose heartbeat is older than this is "
                          "adoptable by any armed survivor "
                          "(default 10000)")
+    ap.add_argument("--device-time-sample", type=int, default=None,
+                    help="device-time sampling rate N: every Nth "
+                         "dispatch per kernel family is timed with a "
+                         "fenced block-until-ready into the "
+                         "kernel_device_ms histogram (1 = every "
+                         "dispatch, 0 = disarmed; default 0). "
+                         "Disarmed cost is one attribute read + one "
+                         "branch per dispatch")
     ap.add_argument("--pack-queries", action="store_true", default=None,
                     help="co-compile packing: bucket compatible "
                          "queries (same source/window/agg signature) "
@@ -302,7 +312,8 @@ def _parse_args(argv):
                 "load_report_interval_ms": None,
                 "placer_interval_ms": None,
                 "heartbeat_lease_ms": None,
-                "pack_queries": False}
+                "pack_queries": False,
+                "device_time_sample": 0}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -353,7 +364,8 @@ def main(argv=None) -> None:
         load_report_interval_ms=cfg["load_report_interval_ms"],
         placer_interval_ms=cfg["placer_interval_ms"],
         heartbeat_lease_ms=cfg["heartbeat_lease_ms"],
-        pack_queries=cfg["pack_queries"])
+        pack_queries=cfg["pack_queries"],
+        device_time_sample=cfg["device_time_sample"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

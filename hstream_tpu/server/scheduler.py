@@ -454,12 +454,21 @@ class QuerySupervisor:
                 qid, deque(maxlen=self.BREAKER_K))
             window.append(now)
             recent = [t for t in window if now - t <= self.BREAKER_W_S]
-            if len(recent) >= self.BREAKER_K:
+            opened = len(recent) >= self.BREAKER_K
+            if opened:
                 self._open_breaker_locked(qid, len(recent))
-                return
-            attempt = len(recent)
-            delay = self._backoff_locked(attempt)
-            self._pending[qid] = (now + delay, info, attempt)
+            else:
+                attempt = len(recent)
+                delay = self._backoff_locked(attempt)
+                self._pending[qid] = (now + delay, info, attempt)
+        if opened:
+            # the black box (ISSUE 18): capture the postmortem bundle
+            # at the breaker edge — OUTSIDE the supervisor lock, since
+            # the capture folds task/stats state behind its own locks
+            rec = getattr(self.ctx, "flightrec", None)
+            if rec is not None:
+                rec.snapshot(qid, trigger="crash_loop_open")
+            return
         self._journal(
             "query_restart_scheduled",
             f"query {qid} restart #{attempt} in {delay:.2f}s "

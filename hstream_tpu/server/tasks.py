@@ -307,6 +307,22 @@ class QueryTask(threading.Thread):
             total += int(getattr(inner, attr, 0))
         return total
 
+    def device_plane_bytes(self) -> dict[str, int]:
+        """Exact live device bytes per engine plane — the HBM
+        accounting fold devicecost.sample_device_gauges scrapes. Zero
+        dispatches, zero fetches: nbytes is shape metadata."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return {}
+        fn = getattr(ex, "device_plane_bytes", None)
+        if fn is None:
+            return {}
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — a half-built executor must
+            return {}      # not kill the stats sweep
+
     def mesh_shards(self) -> int:
         """Key-axis size of the running executor's mesh, 0 when the
         query executes single-chip (no mesh, or a mesh whose key axis

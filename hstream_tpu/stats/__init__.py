@@ -149,6 +149,19 @@ GAUGES = [
                               # score folded from the node's published
                               # record (lower = preferred), sampled at
                               # scrape while node records are fresh
+    "device_hbm_bytes",       # per query: device bytes held by the
+                              # query's live arenas/stores (exact
+                              # nbytes fold), sampled at scrape with
+                              # zero added dispatches (ISSUE 18)
+    "device_arena_bytes",     # per query+plane ("qid/plane" label,
+                              # split at render): device bytes of one
+                              # named arena/store plane
+    "device_hbm_total_bytes", # process total of device_hbm_bytes
+                              # across all live queries
+    "device_hbm_backend_bytes",  # bytes-in-use per the backend's own
+                              # memory_stats() where the platform
+                              # provides it (absent on CPU) — the
+                              # allocator-side cross-check of the fold
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);
@@ -178,6 +191,10 @@ HISTOGRAMS = [
     ("freshness_lag_ms", FRESHNESS_BUCKETS_MS, "stage"),
     # per-kernel-family host dispatch time (step/close/probe/session)
     ("kernel_dispatch_ms", LATENCY_BUCKETS_MS, "family"),
+    # per-kernel-family DEVICE execution time (ISSUE 18): fenced
+    # block-until-ready pairs on a deterministic 1/N dispatch sample
+    # (--device-time-sample), next to the host-wall series above
+    ("kernel_device_ms", LATENCY_BUCKETS_MS, "family"),
     # lock-order witness ledger (ISSUE 14): time spent waiting for /
     # holding each named traced lock, armed runs only
     ("lock_wait_ms", LATENCY_BUCKETS_MS, "lock"),

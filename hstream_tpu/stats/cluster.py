@@ -137,11 +137,20 @@ def node_report(ctx) -> dict:
     # arena occupancy: staged-but-unstepped batches across running
     # query pipelines (the host mirror of device arena pressure)
     arena_pending = 0
+    # device HBM footprint: live arena/store bytes across every running
+    # query's executor planes (ISSUE 18) — nbytes metadata reads only
+    device_hbm = 0
     for task in list(getattr(ctx, "running_queries", {}).values()):
         pipe = getattr(task, "_pipe", None)
         if pipe is not None:
             try:
                 arena_pending += int(pipe.pending)
+            except Exception:  # noqa: BLE001
+                pass
+        fn = getattr(task, "device_plane_bytes", None)
+        if fn is not None:
+            try:
+                device_hbm += sum(fn().values())
             except Exception:  # noqa: BLE001
                 pass
     return {
@@ -150,6 +159,7 @@ def node_report(ctx) -> dict:
         "role": role,
         "ts_ms": int(time.time() * 1000),
         "rss_bytes": rss_bytes(),
+        "device_hbm_bytes": device_hbm,
         "running_queries": len(getattr(ctx, "running_queries", {})),
         "append_inflight": int(front_stats.get("in_flight", 0)),
         "append_front": front_stats,
@@ -182,6 +192,7 @@ def load_report_fields(ctx) -> dict:
         "addr": full["addr"],
         "role": full["role"],
         "rss_bytes": full["rss_bytes"],
+        "device_hbm_bytes": full.get("device_hbm_bytes", 0),
         "running_queries": full["running_queries"],
         "append_inflight": full["append_inflight"],
         "arena_pending_batches": full["arena_pending_batches"],

@@ -62,6 +62,12 @@ EVENT_KINDS = [
                            # live-adopted a lapsed owner's query, or
                            # offered one away in a rebalance — with
                            # the machine-readable reason + scores
+    "flightrec_written",   # the flight recorder snapshotted a
+                           # postmortem bundle for a query (first
+                           # STALLED verdict of an episode, or the
+                           # crash-loop breaker opening) — the pointer
+                           # an operator follows to GET
+                           # /queries/<id>/flightrec
 ]
 
 

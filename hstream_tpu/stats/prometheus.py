@@ -149,6 +149,19 @@ _HELP = {
                     "lock (lock-order witness armed)",
     "lock_hold_ms": "time each named traced lock was held per "
                     "critical section (lock-order witness armed)",
+    "device_hbm_bytes": "device bytes held by the query's live "
+                        "arenas/stores (exact nbytes fold, zero "
+                        "added dispatches)",
+    "device_arena_bytes": "device bytes of one named arena/store "
+                          "plane of a query",
+    "device_hbm_total_bytes": "process total of device_hbm_bytes "
+                              "across all live queries",
+    "device_hbm_backend_bytes": "bytes-in-use per the backend "
+                                "allocator's memory_stats() (absent "
+                                "where the platform reports none)",
+    "kernel_device_ms": "device execution time per kernel family "
+                        "(fenced block-until-ready on a deterministic "
+                        "1/N dispatch sample, --device-time-sample)",
 }
 
 # rate-family HELP text lives on the declaration itself (the one-line
@@ -259,7 +272,16 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
         name = f"{PREFIX}_{metric}"
         _header(lines, name, "gauge", metric)
         for label, v in entries:
-            labels = {_gauge_label_key(metric): label} if label else {}
+            if metric == "device_arena_bytes" and label:
+                # two-dimension gauge (ISSUE 18): the registry key is
+                # "qid/plane" (plane names never contain "/"; query
+                # ids may, so split from the right)
+                qid, _, plane = label.rpartition("/")
+                labels = {"query": qid, "plane": plane}
+            elif label:
+                labels = {_gauge_label_key(metric): label}
+            else:
+                labels = {}
             lines.append(_series(name, labels, v))
     hists = stats.histograms_snapshot()
     seen_types: set[str] = set()
@@ -283,7 +305,7 @@ def render_holder(stats, *, live_streams=None, live_queries=None) -> str:
 
 def _gauge_label_key(metric: str) -> str:
     if metric.startswith(("pipeline_", "query_")) \
-            or metric == "crash_loop_open":
+            or metric in ("crash_loop_open", "device_hbm_bytes"):
         return "query"
     if metric in ("sub_backlog", "credit_inflight"):
         return "subscription"
@@ -428,6 +450,16 @@ def sample_gauges(ctx) -> None:
             stats.stat_drop_stale(scope, live_entity_keys(ctx, scope))
         except Exception:  # noqa: BLE001
             pass
+    # device cost plane (ISSUE 18): exact per-query/per-plane arena
+    # bytes folded from each executor's live device arrays — nbytes
+    # metadata reads only, zero dispatches — plus the process total
+    # and the backend allocator cross-check where one exists
+    try:
+        from hstream_tpu.stats.devicecost import sample_device_gauges
+
+        sample_device_gauges(ctx)
+    except Exception:  # noqa: BLE001 — a half-built context must not
+        pass           # fail the scrape
     # node load axes for the federation fold (ISSUE 15): process rss +
     # append-front queue depth — the same numbers NodeStatsReport and
     # the periodic node_load_report event carry

@@ -202,6 +202,14 @@ def _golden_holder() -> StatsHolder:
     stats.gauge_set("query_watermark_ms", "q1", 1_700_000_000_000)
     stats.gauge_set("query_watermark_lag_ms", "q1", 250.0)
     stats.gauge_set("query_health_level", "q1", 1)
+    # device cost plane gauges (ISSUE 18): per-query HBM total, one
+    # per-plane series (composite "qid/plane" label splits into
+    # {query, plane} at render), process total + backend cross-check
+    stats.gauge_set("device_hbm_bytes", "q1", 4096)
+    stats.gauge_set("device_arena_bytes", "q1/count", 2048)
+    stats.gauge_set("device_arena_bytes", "q1/agg0_sum", 2048)
+    stats.gauge_set("device_hbm_total_bytes", "", 4096)
+    stats.gauge_set("device_hbm_backend_bytes", "", 8192)
     for v in (0.4, 3.0, 40.0):
         stats.observe("append_latency_ms", "s1", v)
     # freshness histograms: per-stage lag + visible latency + emit
@@ -211,6 +219,8 @@ def _golden_holder() -> StatsHolder:
     stats.observe("append_visible_latency_ms", "q1", 45.0)
     stats.observe("emit_latency_ms", "q1", 12.0)
     stats.observe("kernel_dispatch_ms", "step", 1.5)
+    # device-time sampler histogram (ISSUE 18) next to the host wall
+    stats.observe("kernel_device_ms", "step", 0.9)
     # lock-order witness ledger (ISSUE 14): wait/hold + contention
     stats.stream_stat_add("lock_contention", "tasks.state", 3)
     stats.observe("lock_wait_ms", "tasks.state", 0.8)

@@ -88,6 +88,11 @@ HOT_PATH_FILES = (
     # instrumented drain path: a device sync creeping into acquire/
     # release would tax every critical section in the server
     "hstream_tpu/common/locktrace.py",
+    # the device cost plane (ISSUE 18): HBM accounting runs at scrape
+    # time against live executors and the device-time sampler sits
+    # inside every kernel_family scope — each hook declares its budget
+    # (the sampler's fence/measure are the ONLY sanctioned syncs)
+    "hstream_tpu/stats/devicecost.py",
 )
 
 # factories whose RESULT is a compiled kernel callable
