@@ -104,6 +104,10 @@ def load_epoch(store: LogStore) -> int:
 
 
 def _store_epoch(store: LogStore, epoch: int) -> None:
+    # single-writer plane: only THIS process writes its own follower
+    # meta, under _lock, after the caller's epoch ladder decided the
+    # value — a CAS loop here could only race itself
+    # analyze: ok cas-blind-meta-write
     store.meta_put(META_EPOCH, str(int(epoch)).encode())
 # follower reconnect backoff: jittered exponential from _RETRY_S up to
 # _RETRY_CAP_S — a flapping follower must not spin the leader's sender
@@ -185,6 +189,9 @@ def _stable_node_id(store: LogStore) -> str:
     nid = store.meta_get("replica/node_id")
     if nid is None:
         nid = f"leader-{uuid.uuid4().hex[:10]}".encode()
+        # first-boot identity stamp on a store no peer can reach yet
+        # (the server opens the store before serving)
+        # analyze: ok cas-blind-meta-write
         store.meta_put("replica/node_id", nid)
     return nid.decode()
 
@@ -1053,15 +1060,18 @@ class FollowerService:
         if request.epoch > self._epoch:
             self._epoch = int(request.epoch)
             _store_epoch(self.local, self._epoch)
+        # binding writes below are waived as single-writer: only this
+        # follower writes its own durable meta, under _lock, after the
+        # Replicate epoch ladder accepted the leader
         self._leader_id = request.leader_id
-        self.local.meta_put(META_LEADER_ID, request.leader_id.encode())
+        self.local.meta_put(META_LEADER_ID, request.leader_id.encode())  # analyze: ok cas-blind-meta-write
         if request.leader_hint:
             self._leader_hint = request.leader_hint
-            self.local.meta_put(META_LEADER_HINT,
+            self.local.meta_put(META_LEADER_HINT,  # analyze: ok cas-blind-meta-write
                                 request.leader_hint.encode())
         if self._is_leader:
             self._is_leader = False
-            self.local.meta_put(META_IS_LEADER, b"0")
+            self.local.meta_put(META_IS_LEADER, b"0")  # analyze: ok cas-blind-meta-write
         self._journal_event(
             "leader_change",
             f"replica {self.node_id} accepted leader "
@@ -1244,20 +1254,25 @@ class FollowerService:
 
     def _promote_locked(self, epoch: int, leader_addr: str,
                         promoted_by: str) -> None:
-        self._epoch = epoch
+        # monotonicity is the CALLER's guard (Promote refuses
+        # epoch <= self._epoch before getting here; the lease loop
+        # checks the same), so the assignment is bare by design
+        self._epoch = epoch  # analyze: ok cas-epoch-nonmonotone
         _store_epoch(self.local, epoch)
+        # promotion meta below is waived single-writer: own store,
+        # under _lock, behind the caller's epoch guard
         self._is_leader = True
-        self.local.meta_put(META_IS_LEADER, b"1")
+        self.local.meta_put(META_IS_LEADER, b"1")  # analyze: ok cas-blind-meta-write
         self._leader_id = self.node_id
-        self.local.meta_put(META_LEADER_ID, self.node_id.encode())
+        self.local.meta_put(META_LEADER_ID, self.node_id.encode())  # analyze: ok cas-blind-meta-write
         hint = (leader_addr or self.advertise_addr
                 or self.listen_addr or "")
         self._leader_hint = hint or None
         if hint:
-            self.local.meta_put(META_LEADER_HINT, hint.encode())
+            self.local.meta_put(META_LEADER_HINT, hint.encode())  # analyze: ok cas-blind-meta-write
         # a ReplicatedStore later opened over this store must keep this
         # identity, so followers see one continuous leader
-        self.local.meta_put("replica/node_id", self.node_id.encode())
+        self.local.meta_put("replica/node_id", self.node_id.encode())  # analyze: ok cas-blind-meta-write
         log.warning("replica %s PROMOTED to leader at epoch %d "
                     "(by %s; hint %r)", self.node_id, epoch,
                     promoted_by, hint)

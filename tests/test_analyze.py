@@ -30,6 +30,7 @@ from tools.analyze import (  # noqa: E402
 from tools.analyze.passes import (  # noqa: E402
     atomicity,
     blocking,
+    casdiscipline,
     dispatch,
     errcontract,
     lifecycle,
@@ -40,6 +41,7 @@ from tools.analyze.passes import (  # noqa: E402
     registry,
     retrace,
     shardmap,
+    timeunit,
     waitholding,
 )
 
@@ -1982,6 +1984,270 @@ def test_write_baseline_with_only_preserves_other_passes(tmp_path):
     write_baseline([new], path)
     base = load_baseline(path)
     assert kept.key() not in base and new.key() in base
+
+
+# ---- casdiscipline (ISSUE 19) ---------------------------------------------
+
+
+def test_cas_blind_write_on_protocol_key_flagged():
+    code = '''
+    def publish(store, node):
+        store.meta_put("cluster/nodes/" + node, b"{}")
+        store.meta_put("scheduler/query/q1", b"{}")
+        store.meta_delete("vcs/flow/limits")
+        store.meta_put(META_EPOCH, b"3")
+    '''
+    out = run_one(casdiscipline, [src("m.py", code)])
+    assert rules_of(out) == {"cas-blind-meta-write"}
+    assert len(out) == 4
+
+
+def test_cas_blind_write_ignores_data_plane_and_dynamic_keys():
+    code = '''
+    def ok(store, e, key):
+        store.meta_put("snapshots/q1/0", b"...")   # data plane
+        store.meta_put(e.meta_key, e.meta_value)   # replication apply
+        store.meta_put(key, b"x")                  # dynamic
+        store.meta_cas("scheduler/query/q1", None, b"{}")  # the idiom
+    '''
+    assert run_one(casdiscipline, [src("m.py", code)]) == []
+
+
+def test_cas_blind_write_waiver_suppresses():
+    code = '''
+    def stamp(store):
+        # analyze: ok cas-blind-meta-write
+        store.meta_put("replica/node_id", b"n1")
+    '''
+    assert run_one(casdiscipline, [src("m.py", code)]) == []
+
+
+def test_cas_put_version_from_same_function_get_is_clean():
+    code = '''
+    def claim(ctx, key, value):
+        for _ in range(16):
+            cur = ctx.config.get(key)
+            try:
+                ctx.config.put(key, value,
+                               base_version=None if cur is None else cur[0])
+                return
+            except VersionMismatch:
+                continue
+
+    def bump(ctx):
+        cur = ctx.config.get("cluster/boot_epoch")
+        version, raw = cur
+        ctx.config.put("cluster/boot_epoch", b"2", base_version=version)
+        ctx.config.delete("cluster/boot_epoch", base_version=cur[0])
+    '''
+    assert run_one(casdiscipline, [src("m.py", code)]) == []
+
+
+def test_cas_put_foreign_version_flagged():
+    code = '''
+    def overwrite(ctx, key, value, cached_version):
+        ctx.config.put(key, value, base_version=cached_version)
+
+    def constant(ctx, key, value):
+        ctx.config.put(key, value, base_version=3)
+
+    def stale(ctx, key):
+        ctx.config.delete(key, base_version=ctx.last_seen)
+    '''
+    out = run_one(casdiscipline, [src("m.py", code)])
+    assert rules_of(out) == {"cas-put-foreign-version"}
+    assert len(out) == 3
+    assert any("cached_version" in f.message for f in out)
+    assert any("constant version" in f.message for f in out)
+
+
+def test_cas_epoch_nonmonotone_flagged_and_guard_clears():
+    # module mentions load_epoch -> the replication epoch plane
+    code = '''
+    from store import load_epoch
+
+    class F:
+        def promote(self, epoch):
+            self._epoch = epoch          # no guard in scope
+
+        def accept(self, request):
+            if request.epoch > self._epoch:
+                self._epoch = int(request.epoch)
+
+        def boot(self, local):
+            self._epoch = load_epoch(local)
+
+        def bump(self):
+            self._epoch = self._epoch + 1
+    '''
+    out = run_one(casdiscipline, [src("m.py", code)])
+    assert rules_of(out) == {"cas-epoch-nonmonotone"}
+    (f,) = out
+    assert "promote" in f.message
+
+
+def test_cas_epoch_rule_skips_engine_time_epochs():
+    # no load_epoch/boot_epoch/META_EPOCH in the module: `epoch` here
+    # is the executor's timestamp base, not a fencing token
+    code = '''
+    class Executor:
+        def _rebase(self, min_ts, back):
+            self.epoch = min_ts - back
+    '''
+    assert run_one(casdiscipline, [src("m.py", code)]) == []
+
+
+def test_cas_lease_raw_interval_comparison_flagged():
+    code = '''
+    def live(record, now_ms, interval_ms, lease_ms):
+        age = now_ms - record["hb_ms"]
+        if age <= 3 * interval_ms:       # re-derives the bound: BUG
+            return True
+        return age <= lease_ms           # the clamped lease: fine
+    '''
+    out = run_one(casdiscipline, [src("m.py", code)])
+    assert rules_of(out) == {"cas-lease-raw"}
+    assert len(out) == 1
+
+
+def test_casdiscipline_live_tree_only_carries_reviewed_waivers():
+    """Triage verdict, pinned: the production tree is CLEAN after
+    waivers, and the waivers are LOAD-BEARING — stripping the
+    follower-plane waivers in store/replica.py re-exposes exactly the
+    reviewed findings (9 blind single-writer meta writes + 1
+    caller-guarded epoch assignment). A stale waiver or a new
+    violation both break this test."""
+    files = load_tree(REPO)
+    assert run_one(casdiscipline, files) == []
+    replica = next(f for f in files
+                   if f.rel == "hstream_tpu/store/replica.py")
+    raw = [f for f in casdiscipline.run(files, REPO)
+           if f.path == replica.rel]
+    blind = [f for f in raw if f.rule == "cas-blind-meta-write"]
+    epoch = [f for f in raw if f.rule == "cas-epoch-nonmonotone"]
+    assert len(blind) == 9, blind
+    assert len(epoch) == 1, epoch
+    for f in raw:  # every one is suppressed by a reviewed waiver
+        assert replica.waived(f.line, f.rule), f
+
+
+# ---- timeunit (ISSUE 19) ---------------------------------------------------
+
+
+def test_timeunit_mix_flagged():
+    code = '''
+    import time
+
+    def deadline(now_ms, timeout_s):
+        return now_ms + timeout_s            # 1000x off
+
+    def age(start_ms):
+        return time.time() - start_ms        # seconds minus ms
+
+    def expired(hb_ms, lease_timeout_s):
+        if hb_ms > time.monotonic():
+            return True
+        return hb_ms - lease_timeout_s > 0
+    '''
+    out = run_one(timeunit, [src("m.py", code)])
+    assert rules_of(out) == {"timeunit-mix"}
+    assert len(out) == 4
+
+
+def test_timeunit_conversion_factor_clears():
+    code = '''
+    import time
+
+    def ok(now_ms, timeout_s, dur_ms):
+        a = now_ms + timeout_s * 1000
+        b = time.time() * 1e3 - dur_ms
+        c = now_ms * 0.001 - timeout_s
+        d = int(time.time() * 1000) - dur_ms
+        return a, b, c, d
+    '''
+    assert run_one(timeunit, [src("m.py", code)]) == []
+
+
+def test_timeunit_ignores_non_time_identifiers():
+    code = '''
+    def ok(stats, args, items, vals):
+        total = stats + args                 # trailing s != seconds
+        if items > vals:
+            return total
+        ms = 5
+        return ms + 3                        # same-unit arithmetic
+    '''
+    assert run_one(timeunit, [src("m.py", code)]) == []
+
+
+def test_timeunit_waiver_suppresses():
+    code = '''
+    def f(now_ms, timeout_s):
+        return now_ms + timeout_s  # analyze: ok timeunit-mix
+    '''
+    assert run_one(timeunit, [src("m.py", code)]) == []
+
+
+def test_timeunit_live_tree_clean():
+    assert run_one(timeunit, load_tree(REPO)) == []
+
+
+# ---- waiver-dead (stale-waiver audit, ISSUE 19) ----------------------------
+
+
+def test_dead_waiver_flagged_live_waiver_not():
+    code = '''
+    def f(now_ms, timeout_s, x_ms, y_ms):
+        a = now_ms + timeout_s  # analyze: ok timeunit-mix
+        b = x_ms + y_ms         # analyze: ok timeunit-mix
+        return a + b
+    '''
+    out, _rules = run_passes([src("m.py", code)], only=["timeunit"])
+    assert rules_of(out) == {"waiver-dead"}
+    (f,) = out
+    assert f.line == 4  # the same-unit line: its waiver excuses nothing
+    assert "timeunit-mix" in f.message
+
+
+def test_dead_waiver_scoped_to_selected_passes():
+    code = '''
+    def f():
+        return 1  # analyze: ok lock-guard
+    '''
+    # lock-guard's pass did not run: the waiver is not auditable here
+    out, _ = run_passes([src("m.py", code)], only=["timeunit"])
+    assert out == []
+    # ... and IS dead once its pass runs
+    out, _ = run_passes([src("m.py", code)], only=["locks"])
+    assert rules_of(out) == {"waiver-dead"}
+
+
+def test_bare_waiver_audited_only_on_full_runs():
+    from tools.analyze import _dead_waivers
+
+    files = [src("m.py", "x = 1  # analyze: ok\n")]
+    assert _dead_waivers(files, {"timeunit-mix"}, {},
+                         all_selected=False) == []
+    out = _dead_waivers(files, {"timeunit-mix"}, {}, all_selected=True)
+    assert [f.rule for f in out] == ["waiver-dead"]
+
+
+def test_comment_line_waiver_credits_next_line_suppression():
+    code = '''
+    def f(now_ms, timeout_s):
+        # analyze: ok timeunit-mix
+        return now_ms + timeout_s
+    '''
+    out, _ = run_passes([src("m.py", code)], only=["timeunit"])
+    assert out == []
+
+
+def test_waiver_dead_live_tree_clean():
+    """Every waiver in the production tree still suppresses a finding
+    of every rule it names — the 27 reviewed exceptions are all
+    load-bearing."""
+    out, _ = run_passes(load_tree(REPO))
+    assert [f for f in out if f.rule == "waiver-dead"] == []
 
 
 def test_full_tree_runs_clean():

@@ -1605,3 +1605,56 @@ def _read_chaos_sink(ctx, stream):
                 if row is not None:
                     out.append(row)
     return out
+
+
+# ---- protocheck counterexamples as chaos schedules (ISSUE 19) ---------------
+#
+# The model checker in tools/protocheck emits counterexamples as ACTION
+# SCHEDULES — the same shape as the fault schedules above: a literal
+# list of (action, node) steps anyone can replay. The schedules below
+# were rendered from real mutation-gate counterexamples and are pinned
+# here as chaos regressions: under the reverted fix the schedule
+# reproduces the exact violation; on the LIVE tree the same schedule is
+# clean. If a refactor re-introduces one of these bugs, the live half
+# fails with a replayable script of the split-brain.
+
+PROTOCHECK_SCHEDULES = [
+    # reverting the fresh-lease refusal in try_adopt_live: one adopt
+    # sweep steals a query whose owner heartbeated 0ms ago
+    ("fresh-heartbeat-refusal", "kill-2",
+     [("adopt", 0)], "seizure-fresh-lease", False),
+    # reverting the 3x-interval lease clamp: after one crash and two
+    # clock advances the survivor seizes a lease that SHOULD still be
+    # live under the clamped bound
+    ("lease-unclamped", "clamp-2",
+     [("crash", 0), ("advance",), ("advance",), ("adopt", 1)],
+     "seizure-fresh-lease", False),
+    # reverting the CREATED-rescue in the adopt sweep: the offeree
+    # crashes and the offered-but-never-launched query is stranded
+    ("created-no-rescue", "created-2",
+     [("crash", 1)], "convergence-offer", True),
+]
+
+
+@pytest.mark.parametrize(
+    "mutant,scenario,schedule,rule,stabilized",
+    PROTOCHECK_SCHEDULES, ids=[s[0] for s in PROTOCHECK_SCHEDULES])
+def test_protocheck_schedule_replays_bug_and_live_fix(
+        mutant, scenario, schedule, rule, stabilized):
+    from tools.protocheck.explore import replay
+    from tools.protocheck.model import SCENARIOS
+    from tools.protocheck.mutants import BY_NAME
+
+    m = BY_NAME[mutant]
+    # under the reverted fix the schedule reproduces the violation,
+    # deterministically (identical canonical state at every step)
+    v1, k1, _ = replay(SCENARIOS[scenario], schedule, mutant=m,
+                       stabilize=stabilized)
+    v2, k2, _ = replay(SCENARIOS[scenario], schedule, mutant=m,
+                       stabilize=stabilized)
+    assert v1 and v1[0].rule == rule, (mutant, [str(v) for v in v1])
+    assert k1 == k2
+    # the live tree survives the exact same schedule
+    v_live, _, _ = replay(SCENARIOS[scenario], schedule,
+                          stabilize=stabilized)
+    assert not v_live, (mutant, [str(v) for v in v_live])

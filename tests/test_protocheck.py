@@ -1,0 +1,257 @@
+"""Protocol certification (ISSUE 19): the explicit-state model checker
+in tools/protocheck drives the REAL scheduler/placer/replica protocol
+functions through exhaustive bounded interleavings.
+
+Three properties are pinned here:
+
+* the LIVE tree passes every scenario's invariants (the certification
+  itself — a regression in try_adopt_live / _heartbeat_owned /
+  Promote/Replicate shows up as a counterexample in this file);
+* the mutation gate has teeth: every mechanically reverted PR 9/PR 17
+  review fix yields a counterexample (the checker can actually SEE the
+  bugs those fixes closed — a green run is evidence, not vacuity);
+* counterexample traces are deterministic, serializable schedules:
+  replaying one reproduces the same canonical state at every step.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.protocheck.explore import Counterexample, explore, replay
+from tools.protocheck.model import DEFAULT_SCENARIOS, SCENARIOS, Model
+from tools.protocheck.mutants import BY_NAME, MUTANTS
+from tools.protocheck.replica_model import (MiniLogStore, ReplicaModel,
+                                            ReplicaScenario,
+                                            explore_replica,
+                                            replay_replica)
+
+# ---- live-tree certification ----------------------------------------------
+
+# the fast half of the registry runs per-scenario for precise failure
+# attribution; the two slowest run together under one budget marker
+_FAST = [n for n in DEFAULT_SCENARIOS
+         if n in ("skew-2", "mixed-2", "clamp-2", "created-2")]
+_SLOW = [n for n in DEFAULT_SCENARIOS if n not in _FAST]
+
+
+@pytest.mark.parametrize("name", _FAST)
+def test_live_tree_certified_fast_scenarios(name):
+    res = explore(SCENARIOS[name])
+    assert res.ok, (
+        f"{name}: live tree violates {res.counterexample.rule}: "
+        f"{res.counterexample.message}\n"
+        f"trace: {res.counterexample.trace}")
+    assert res.states > 5  # the scenario actually explored something
+
+
+@pytest.mark.parametrize("name", _SLOW)
+def test_live_tree_certified_deep_scenarios(name):
+    res = explore(SCENARIOS[name])
+    assert res.ok, (
+        f"{name}: live tree violates {res.counterexample.rule}: "
+        f"{res.counterexample.message}\n"
+        f"trace: {res.counterexample.trace}")
+    assert res.states > 100
+    assert res.elapsed_s < 30  # CI bound: whole module stays tier-1
+
+
+def test_live_tree_replica_model_certified():
+    res = explore_replica(ReplicaScenario())
+    assert res.ok, (
+        f"replica model violates {res.counterexample.rule}: "
+        f"{res.counterexample.message}")
+    assert res.states > 100
+
+
+# ---- mutation gate ---------------------------------------------------------
+
+
+def test_gate_covers_at_least_five_reverted_fixes():
+    assert len(MUTANTS) >= 5
+    assert len({m.name for m in MUTANTS}) == len(MUTANTS)
+
+
+@pytest.mark.parametrize("name", sorted(BY_NAME))
+def test_mutant_yields_counterexample(name):
+    m = BY_NAME[name]
+    if m.kind == "replica":
+        res = explore_replica(ReplicaScenario(), mutant=m)
+    else:
+        res = explore(SCENARIOS[m.scenario], mutant=m)
+    assert not res.ok, (
+        f"mutant {name} (reverts: {m.fix}) went UNNOTICED over "
+        f"{res.states} states — the checker lost the invariant that "
+        f"certifies this fix")
+    ce = res.counterexample
+    assert ce.mutant == name
+    assert ce.rule and ce.message
+
+
+def test_mutants_restore_the_live_functions():
+    """The patch contextmanagers must leave no residue: after a mutant
+    run, the live module attributes are back and a live exploration is
+    still clean."""
+    import hstream_tpu.server.scheduler as sched
+
+    before = sched.try_adopt_live
+    res = explore(SCENARIOS["kill-2"],
+                  mutant=BY_NAME["fresh-heartbeat-refusal"])
+    assert not res.ok
+    assert sched.try_adopt_live is before
+    assert explore(SCENARIOS["clamp-2"]).ok
+
+
+def test_exploration_restores_the_tree_logger_level():
+    """quiet_protocol_logs mutes the hstream_tpu root logger during a
+    run; the mute must not leak into tests that run after this module
+    in the same process (they assert on log records)."""
+    import logging
+
+    root = logging.getLogger("hstream_tpu")
+    before = root.level
+    explore(SCENARIOS["clamp-2"])
+    explore_replica(ReplicaScenario())
+    assert root.level == before
+
+
+# ---- counterexample replay determinism ------------------------------------
+
+
+def test_trace_replays_deterministically():
+    m = BY_NAME["fresh-heartbeat-refusal"]
+    res = explore(SCENARIOS[m.scenario], mutant=m)
+    ce = res.counterexample
+    v1, k1, _ = replay(SCENARIOS[m.scenario], ce.trace, mutant=m)
+    v2, k2, _ = replay(SCENARIOS[m.scenario], ce.trace, mutant=m)
+    assert v1 and v1[0].rule == ce.rule
+    assert k1 == k2  # same canonical state at every step
+    # the SAME schedule on the LIVE tree is clean: the fix, not the
+    # schedule, is what the counterexample demonstrates
+    v_live, _, _ = replay(SCENARIOS[m.scenario], ce.trace)
+    assert not v_live
+
+
+def test_stabilized_counterexample_replays_with_convergence():
+    m = BY_NAME["legacy-epoch-adopt"]
+    res = explore(SCENARIOS[m.scenario], mutant=m)
+    ce = res.counterexample
+    assert ce.stabilized
+    vs, _, _ = replay(SCENARIOS[m.scenario], ce.trace, mutant=m,
+                      stabilize=True)
+    assert vs and vs[0].rule == ce.rule
+
+
+def test_replica_trace_replays_deterministically():
+    m = BY_NAME["promote-no-epoch-guard"]
+    res = explore_replica(ReplicaScenario(), mutant=m)
+    ce = res.counterexample
+    v1, k1 = replay_replica(ce.trace, mutant=m,
+                            stabilize=ce.stabilized)
+    v2, k2 = replay_replica(ce.trace, mutant=m,
+                            stabilize=ce.stabilized)
+    assert v1 and v1[0].rule == ce.rule
+    assert k1 == k2
+    v_live, _ = replay_replica(ce.trace, stabilize=ce.stabilized)
+    assert not v_live
+
+
+def test_counterexample_json_round_trip():
+    m = BY_NAME["lease-unclamped"]
+    ce = explore(SCENARIOS[m.scenario], mutant=m).counterexample
+    back = Counterexample.from_json(json.loads(json.dumps(ce.to_json())))
+    assert back.trace == ce.trace
+    assert (back.rule, back.scenario, back.mutant) == \
+        (ce.rule, ce.scenario, ce.mutant)
+    vs, _, _ = replay(SCENARIOS[back.scenario], back.trace, mutant=m,
+                      stabilize=back.stabilized)
+    assert vs and vs[0].rule == back.rule
+
+
+def test_timeline_renders_every_step():
+    m = BY_NAME["fresh-heartbeat-refusal"]
+    ce = explore(SCENARIOS[m.scenario], mutant=m).counterexample
+    _vs, keys, steps = replay(SCENARIOS[m.scenario], ce.trace,
+                              mutant=m, timeline=True)
+    assert len(steps) == len(ce.trace) + 1  # initial + one per action
+    assert steps[0]["action"] == "initial"
+    for st in steps:
+        assert {"action", "clock_ms", "nodes", "records"} <= set(st)
+        for n in st["nodes"]:
+            assert {"name", "alive", "epoch", "running"} <= set(n)
+    assert len(keys) == len(steps)
+
+
+# ---- model soundness spot-checks ------------------------------------------
+
+
+def test_snapshot_restore_is_exact():
+    model = Model(SCENARIOS["kill-2"])
+    with model.engaged():
+        k0 = model.state_key()
+        snap = model.snapshot()
+        for a in (("advance",), ("crash", 0), ("adopt", 1)):
+            pre = model.sched_records()
+            model.execute(a)
+            model.update_truth(a, pre, model.sched_records())
+        assert model.state_key() != k0
+        model.restore(snap)
+        assert model.state_key() == k0
+
+
+def test_state_key_is_translation_invariant():
+    """Canonicalization folds absolute time out: advancing the clock
+    with all heartbeats refreshed in lockstep reaches an
+    already-visited canonical state (this is what makes the bounded
+    space finite and the visited-set effective)."""
+    model = Model(SCENARIOS["pause-2"])
+    with model.engaged():
+        def hb_all():
+            for i in (0, 1):
+                a = ("hb", i)
+                pre = model.sched_records()
+                model.execute(a)
+                model.update_truth(a, pre, model.sched_records())
+        hb_all()
+        k1 = model.state_key()
+        pre = model.sched_records()
+        model.execute(("advance",))
+        model.update_truth(("advance",), pre, model.sched_records())
+        hb_all()
+        k2 = model.state_key()
+        # keys differ only in the advance budget, not in time itself
+        strip = [i for i, (a, b) in enumerate(zip(k1, k2)) if a != b]
+        assert len(strip) == 1
+
+
+def test_minilogstore_matches_contract():
+    s = MiniLogStore()
+    assert not s.log_exists(7)
+    s.create_log(7)
+    assert s.tail_lsn(7) == 0
+    assert s.append(7, b"x") == 1
+    s.meta_put("k", b"v")
+    assert s.meta_get("k") == b"v"
+    snap = s.snapshot()
+    s.append(7, b"y")
+    s.meta_delete("k")
+    s.restore(snap)
+    assert s.tail_lsn(7) == 1 and s.meta_get("k") == b"v"
+
+
+def test_replica_model_runs_real_follower_service():
+    from hstream_tpu.store.replica import FollowerService
+
+    model = ReplicaModel(ReplicaScenario())
+    assert all(isinstance(f, FollowerService) for f in model.followers)
+    assert not model.execute(("promote", 0))
+    assert model.followers[0].is_leader
+    assert model.followers[0].epoch == 1
+    # the duel: r2 promoted at the SAME epoch, then full contact
+    # resolves to the higher node id
+    assert not model.execute(("promote-dup", 1))
+    assert not model.stabilize()
+    leaders = [f.node_id for f in model.followers if f.is_leader]
+    assert leaders == ["r2"]

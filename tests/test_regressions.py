@@ -1234,3 +1234,87 @@ def test_mesh_exclusions_join_and_sessions_retired():
         "TUMBLING (INTERVAL 10 SECOND) EMIT CHANGES;")
     reason = cg.mesh_exclusion_reason(plan)
     assert reason is not None and "stream-TABLE" in reason
+
+
+# ---- ISSUE 19: protocol certification — triage verdicts pinned --------------
+#
+# The casdiscipline/timeunit triage found NO true positives on the live
+# tree: every finding is a reviewed single-writer exception in
+# store/replica.py (waivers pinned load-bearing in test_analyze.py).
+# What the waivers LEAN ON is behavior, so the behavior is pinned here:
+# each test below is the runtime fact that makes one reviewed waiver
+# (or one certified invariant) sound.
+
+
+def test_placer_lease_clamped_to_three_intervals():
+    """The clamp the `cas-lease-raw` rule protects: a lease below 3x
+    the placer tick is raised at construction, so no age comparison
+    ever runs against a sub-interval lease."""
+    from hstream_tpu.placer.core import Placer
+
+    p = Placer(object(), interval_ms=2000, lease_ms=2000)
+    assert p.armed and p.lease_ms == 6000
+    # a sane lease is untouched, and a disarmed placer never clamps
+    assert Placer(object(), interval_ms=1000, lease_ms=5000).lease_ms \
+        == 5000
+    assert Placer(object(), interval_ms=None, lease_ms=2000).lease_ms \
+        == 2000
+
+
+def test_live_adoption_refuses_fresh_heartbeat():
+    """The fresh-lease refusal in try_adopt_live (protocheck mutant
+    `fresh-heartbeat-refusal`): an adopt sweep must NOT seize a query
+    whose owner heartbeated within the lease."""
+    from tools.protocheck.model import SCENARIOS, Model
+
+    model = Model(SCENARIOS["kill-2"])
+    with model.engaged():
+        pre = model.sched_records()
+        model.execute(("adopt", 0))
+        post = model.sched_records()
+        # both records untouched: every owner's heartbeat is 0ms old
+        assert {q: r for q, (_raw, r) in post.items()} == \
+            {q: r for q, (_raw, r) in pre.items()}
+
+
+def test_promote_epoch_guard_keeps_durable_epoch():
+    """The guard backing the `cas-epoch-nonmonotone` waiver on
+    `_promote_locked`: Promote refuses epoch <= current BEFORE the
+    bare assignment runs, so the durable epoch never moves backwards
+    even though the write itself is unguarded."""
+    from hstream_tpu.proto import api_pb2 as pb
+    from tools.protocheck.replica_model import MiniLogStore, _GrpcCtx
+
+    from hstream_tpu.store.replica import META_EPOCH, FollowerService
+
+    f = FollowerService(MiniLogStore(), node_id="r1")
+    ok = f.Promote(pb.PromoteRequest(epoch=2, leader_addr="a",
+                                     promoted_by="t"), _GrpcCtx())
+    assert ok.ok and f.epoch == 2
+    again = f.Promote(pb.PromoteRequest(epoch=2, leader_addr="b",
+                                        promoted_by="t"), _GrpcCtx())
+    assert not again.ok
+    assert f.epoch == 2 and f.local.meta_get(META_EPOCH) == b"2"
+
+
+def test_fenced_replicate_leaves_binding_writes_unrun():
+    """The fence backing the `cas-blind-meta-write` waivers in
+    `_accept_leader_locked`: a stale-epoch Replicate is refused before
+    ANY of the blind single-writer meta writes run, so the durable
+    binding only ever changes under an accepted (higher-epoch)
+    leader."""
+    from hstream_tpu.proto import api_pb2 as pb
+    from tools.protocheck.replica_model import MiniLogStore, _GrpcCtx
+
+    from hstream_tpu.store.replica import FollowerService
+
+    store = MiniLogStore()
+    f = FollowerService(store, node_id="r1")
+    r = f.Replicate(pb.ReplicateRequest(epoch=3, leader_id="L3"),
+                    _GrpcCtx())
+    assert not r.fenced
+    before = store.fingerprint()
+    stale = f.Replicate(pb.ReplicateRequest(epoch=2, leader_id="L2"),
+                        _GrpcCtx())
+    assert stale.fenced and stale.epoch == 3
+    assert store.fingerprint() == before

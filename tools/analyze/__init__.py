@@ -155,6 +155,7 @@ def all_passes() -> dict[str, object]:
     from tools.analyze.passes import (
         atomicity,
         blocking,
+        casdiscipline,
         dispatch,
         errcontract,
         lifecycle,
@@ -165,13 +166,15 @@ def all_passes() -> dict[str, object]:
         registry,
         retrace,
         shardmap,
+        timeunit,
         waitholding,
     )
 
     return {m.NAME: m for m in
             (locks, lockorder, atomicity, waitholding, blocking,
              purity, dispatch, retrace, overflow, shardmap,
-             errcontract, lifecycle, registry)}
+             errcontract, lifecycle, registry, casdiscipline,
+             timeunit)}
 
 
 def rule_passes() -> dict[str, str]:
@@ -221,15 +224,68 @@ def run_passes(files: list[SourceFile], only: list[str] | None = None,
     by_rel = {f.rel: f for f in files}
     rules: dict[str, str] = {}
     out: list[Finding] = []
+    # (path, line) -> rules actually suppressed there, for the
+    # stale-waiver audit below
+    suppressed: dict[tuple[str, int], set[str]] = {}
     for mod in passes.values():
         rules.update(mod.RULES)
         for finding in mod.run(files, repo):
             src = by_rel.get(finding.path)
             if src is not None and src.waived(finding.line, finding.rule):
+                suppressed.setdefault(
+                    (finding.path, finding.line), set()).add(finding.rule)
                 continue
             out.append(finding)
+    out.extend(_dead_waivers(files, set(rules), suppressed,
+                             all_selected=only is None))
+    rules[WAIVER_DEAD_RULE] = WAIVER_DEAD_DOC
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out, rules
+
+
+WAIVER_DEAD_RULE = "waiver-dead"
+WAIVER_DEAD_DOC = (
+    "an `# analyze: ok` waiver that suppressed nothing in this run — "
+    "the code it excused was fixed or moved, and a stale waiver is a "
+    "standing license for the next regression at that site; delete "
+    "the comment (waiver-dead findings cannot themselves be waived)")
+
+
+def _dead_waivers(files: list[SourceFile], selected_rules: set[str],
+                  suppressed: dict[tuple[str, int], set[str]],
+                  all_selected: bool) -> list[Finding]:
+    """The stale-waiver audit: every waiver comment must still suppress
+    at least one finding of every rule it names. Scoped to the passes
+    that ran — a waiver naming an unselected pass's rule is skipped,
+    and BARE waivers (`# analyze: ok` with no rule list) are only
+    auditable when every pass ran."""
+    out: list[Finding] = []
+    for src in files:
+        for i, line in enumerate(src.lines, start=1):
+            m = _WAIVER_RE.search(line)
+            if not m:
+                continue
+            named = {r.strip() for r in m.group(1).split(",")
+                     if r.strip()}
+            # a comment-only waiver line covers the next line too
+            covered = ({i, i + 1} if line.lstrip().startswith("#")
+                       else {i})
+            hits: set[str] = set()
+            for ln in covered:
+                hits |= suppressed.get((src.rel, ln), set())
+            if not named:
+                if all_selected and not hits:
+                    out.append(Finding(
+                        WAIVER_DEAD_RULE, src.rel, i,
+                        "bare waiver suppresses nothing — delete it"))
+                continue
+            for rule in sorted(named & selected_rules):
+                if rule not in hits:
+                    out.append(Finding(
+                        WAIVER_DEAD_RULE, src.rel, i,
+                        f"waiver for {rule} suppresses nothing — the "
+                        f"excused finding is gone; delete the waiver"))
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -242,7 +298,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated pass names "
                          "(locks,lockorder,atomicity,waitholding,"
                          "blocking,purity,dispatch,retrace,overflow,"
-                         "shardmap,errcontract,lifecycle,registry)")
+                         "shardmap,errcontract,lifecycle,registry,"
+                         "casdiscipline,timeunit)")
     ap.add_argument("--stats", action="store_true",
                     help="emit per-rule finding counts (incl. baselined)")
     ap.add_argument("--json", action="store_true",
@@ -271,6 +328,9 @@ def main(argv: list[str] | None = None) -> int:
                                  f"valid: {sorted(passes)}")
             for rid, doc in sorted(passes[name].RULES.items()):
                 print(f"{rid}: {doc}")
+        if only is None:
+            # the framework-level waiver audit rides every full run
+            print(f"{WAIVER_DEAD_RULE}: {WAIVER_DEAD_DOC}")
         return 0
 
     files = load_tree(args.repo)
