@@ -1017,6 +1017,110 @@ def _loopback_server_path() -> dict:
         f"{proc.stderr[-400:]}")
 
 
+def bench_read_plane() -> dict:
+    """Read plane (ISSUE 20): N concurrent pull readers over one live
+    view — the snapshot cache must collapse them onto ~one executor
+    extract per close cycle (extracts_per_read -> 1/N) — plus the
+    shared-encode fan-out phase: one columnar sink record delivered to
+    M consumers costs ONE expansion (encode_amortization -> M)."""
+    import threading
+
+    from hstream_tpu.common import columnar, locktrace
+    from hstream_tpu.common import records as rec
+    from hstream_tpu.server.readcache import ReadCache
+    from hstream_tpu.server.subscriptions import _expand_columnar
+    from hstream_tpu.server.views import Materialization
+    from hstream_tpu.sql.codegen import stream_codegen
+
+    N_READERS = 8
+    DURATION_S = 3.0
+    ex, feed, warm = _smoke_tumbling_config()
+
+    class _Owner:  # the QueryTask surface the read path needs
+        state_lock = locktrace.rlock("tasks.state")
+        executor = ex
+
+    mat = Materialization(group_cols=["device"])
+    mat.task = _Owner()
+    sel = stream_codegen("SELECT * FROM v;").select
+    cache = ReadCache()
+
+    batch_i = [0]
+
+    def feed_locked():
+        # engine mutations under the task lock, exactly like the real
+        # query loop — the version probe's exactness depends on it
+        with _Owner.state_lock:
+            i = batch_i[0]
+            batch_i[0] += 1
+            rows = feed(i)
+            if rows is not None and len(rows):
+                mat.add_closed(rows)
+
+    for _ in range(warm):
+        feed_locked()
+    cache.serve_view("v", mat, sel, "q")  # warm the extract shapes
+    ex.block_until_ready()
+
+    stop = threading.Event()
+    reads = [0] * N_READERS
+
+    def reader(slot):
+        while not stop.is_set():
+            cache.serve_view("v", mat, sel, "q")
+            reads[slot] += 1
+
+    extracts0 = cache.extracts
+    threads = [threading.Thread(target=reader, args=(i,))
+               for i in range(N_READERS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    fed = 0
+    while time.perf_counter() - t0 < DURATION_S:
+        feed_locked()
+        fed += 1
+        time.sleep(0.005)
+    stop.set()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    total_reads = sum(reads)
+    extracts = cache.extracts - extracts0
+
+    # fan-out phase: M consumers of one immutable columnar record
+    M = 256
+    rows = [{"k": f"g{i}", "c": i} for i in range(64)]
+    payload = rec.build_record(
+        columnar.rows_to_payload(rows, 1_700_000_000_000)
+    ).SerializeToString()
+    t1 = time.perf_counter()
+    for _ in range(M):
+        frames = _expand_columnar(payload)
+    t_direct = time.perf_counter() - t1
+    fan = ReadCache()
+    t2 = time.perf_counter()
+    for _ in range(M):
+        frames = fan.expand_frames(1, 1, 0, payload, _expand_columnar)
+    t_shared = time.perf_counter() - t2
+    assert frames is not None and fan.stats()["expand_misses"] == 1
+    return {
+        "readers": N_READERS,
+        "reads_per_sec": round(total_reads / dt),
+        "batches_fed": fed,
+        "extracts": extracts,
+        # ~1/N: one extract serves every concurrent reader of a cycle
+        "extracts_per_read": round(extracts / max(total_reads, 1), 4),
+        "extracts_per_reader": round(
+            extracts / max(total_reads / N_READERS, 1), 4),
+        "hit_ratio": round(cache.hit_ratio(), 4),
+        "fanout_consumers": M,
+        # M consumers per single encode (expand_misses == 1)
+        "encode_amortization": M / fan.stats()["expand_misses"],
+        "encode_once_speedup": round(t_direct / max(t_shared, 1e-9), 1),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1243,6 +1347,7 @@ def main() -> None:
         "store_append": safe("store", bench_store_append,
                              tempfile.gettempdir()),
         "snapshot_100k": safe("snap", bench_snapshot_overhead),
+        "read_plane": safe("read_plane", bench_read_plane),
     }
     print(json.dumps(result))
     pipe.close()
@@ -1282,8 +1387,8 @@ def _smoke_tumbling_config():
 
     def feed(i):
         kids, temps = uniq[i % 4]
-        ex.process_columnar(kids, base + i * 200 + ts_template,
-                            {"temp": temps})
+        return ex.process_columnar(kids, base + i * 200 + ts_template,
+                                   {"temp": temps})
 
     # warmup spans >= 2 close cycles at 1s windows / 200ms batches
     return ex, feed, 15
@@ -1490,6 +1595,49 @@ def _smoke_server_columnar(batches: int = 50) -> int:
         ctx.shutdown()
 
 
+def _smoke_read_plane(batches: int = 50) -> int:
+    """Read-plane retrace gate (ISSUE 20): steady-state pull serves —
+    cache hits, version-miss recomputes (one batched peek extract), and
+    closed-only fast-path serves — over a live fused-close run must
+    compile ZERO new XLA executables. Returns the compile count."""
+    from hstream_tpu.common import locktrace
+    from hstream_tpu.common.tracing import RetraceGuard
+    from hstream_tpu.server.readcache import ReadCache
+    from hstream_tpu.server.views import Materialization
+    from hstream_tpu.sql.codegen import stream_codegen
+
+    ex, feed, warm = _smoke_tumbling_config()
+
+    class _Owner:
+        state_lock = locktrace.rlock("tasks.state")
+        executor = ex
+
+    mat = Materialization(group_cols=["device"])
+    mat.task = _Owner()
+    cache = ReadCache()
+    sel_all = stream_codegen("SELECT * FROM v;").select
+    sel_closed = stream_codegen(
+        "SELECT * FROM v WHERE winEnd < 1;").select  # never peeks
+
+    def step(i):
+        with _Owner.state_lock:
+            rows = feed(i)
+            if rows is not None and len(rows):
+                mat.add_closed(rows)
+        cache.serve_view("v", mat, sel_all, "all")     # miss: one peek
+        cache.serve_view("v", mat, sel_all, "all")     # hit: no device
+        cache.serve_view("v", mat, sel_closed, "cl")   # fast path
+
+    for i in range(warm):
+        step(i)
+    ex.block_until_ready()
+    with RetraceGuard() as g:
+        for i in range(warm, warm + batches):
+            step(i)
+        ex.block_until_ready()
+    return g.count
+
+
 def _smoke_run(config, batches: int = 50) -> int:
     """Warm one smoke config, then count XLA compiles over `batches`
     steady-state batches (contract: 0)."""
@@ -1630,6 +1778,7 @@ def smoke_main() -> None:
         join = _smoke_run(_smoke_join_config)
         session = _smoke_run(_smoke_session_config)
         server_columnar = _smoke_server_columnar()
+        read_plane = _smoke_read_plane()
     finally:
         armed = DEVICE_TIME.state()
         sampler_armed_samples = sum(armed["samples"].values())
@@ -1646,11 +1795,12 @@ def smoke_main() -> None:
         "metric": "recompiles_per_run",
         "mode": "smoke",
         "value": tumbling + join + session + server_columnar
-        + max(sharded_join, 0) + max(sharded_session, 0),
+        + read_plane + max(sharded_join, 0) + max(sharded_session, 0),
         "tumbling_recompiles": tumbling,
         "join_recompiles": join,
         "session_recompiles": session,
         "server_columnar_recompiles": server_columnar,
+        "read_plane_recompiles": read_plane,
         "sharded_join_recompiles": sharded_join,
         "sharded_session_recompiles": sharded_session,
         "sharded_devices": sharded.get("devices"),
@@ -1663,8 +1813,8 @@ def smoke_main() -> None:
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))
-    if tumbling or join or session or server_columnar or sharded_bad \
-            or disarmed_probe:
+    if tumbling or join or session or server_columnar or read_plane \
+            or sharded_bad or disarmed_probe:
         print("# retrace gate FAILED: steady-state batches compiled "
               "new XLA executables", flush=True)
         sys.exit(1)

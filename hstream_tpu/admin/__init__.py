@@ -458,6 +458,21 @@ def cmd_flow(stub, args) -> list[dict]:
     return rows
 
 
+def cmd_read_cache(stub, args) -> list[dict]:
+    """Read-plane snapshot/expansion cache counters: hit ratio, byte
+    budget occupancy, extracts, evictions, invalidations."""
+    out = _admin(stub, "read-cache")[0]
+    if not out.get("enabled"):
+        return [{"": "enabled", "value": False,
+                 "detail": "started with --read-cache-bytes 0"}]
+    rows = []
+    for key in sorted(out):
+        if key == "enabled":
+            continue
+        rows.append({"": key, "value": out[key], "detail": ""})
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "hstream-tpu-admin",
@@ -573,6 +588,9 @@ def main(argv=None) -> int:
     sub.add_parser("flow",
                    help="live flow-control status: shed level, "
                         "overload signals, quotas")
+    sub.add_parser("read-cache",
+                   help="read-plane snapshot cache counters: hit "
+                        "ratio, bytes, extracts, evictions")
     p = sub.add_parser("events",
                        help="operator event journal: shed transitions, "
                             "degraded appends, adoption, snapshot "

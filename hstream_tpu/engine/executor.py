@@ -24,6 +24,7 @@ the device pipelining enqueued steps.
 
 from __future__ import annotations
 
+import itertools
 import math
 import threading
 import time
@@ -87,6 +88,12 @@ def _change_drain_pool() -> futures.ThreadPoolExecutor:
 
 def _align_down(ts: int, step: int) -> int:
     return ts - (ts % step)
+
+
+# Per-instance read-version nonces (ISSUE 20): a restored/rebuilt
+# executor must never alias a predecessor's version tuple, so every
+# instance draws a process-unique id at construction.
+_READ_NONCE = itertools.count(1)
 
 
 @dataclass
@@ -267,6 +274,14 @@ class QueryExecutor:
         self.dispatch_observer = None   # callable (family, seconds)
         self.late_drops = 0
         self.transfer_stats = {"h2d_bytes": 0, "d2h_bytes": 0}
+        # read-plane versioning (ISSUE 20): read_epoch bumps at every
+        # state-mutating choke point (step dispatch, window close), so
+        # (nonce, read_epoch, close_cycles, watermark) is an exact key
+        # for "would peek() return the same rows". Plain int writes —
+        # readers may sample it lock-free; a torn read can only cause a
+        # spurious cache miss, never a stale hit.
+        self.read_epoch = 0
+        self._read_nonce = next(_READ_NONCE)
 
     def _extract_filter(self) -> Expr | None:
         # Walk the child chain down to the source, ANDing every FilterNode
@@ -361,6 +376,7 @@ class QueryExecutor:
         — is stable batch-to-batch."""
         if FAULTS.active:  # chaos: fail/delay a staged step dispatch
             FAULTS.point("device.dispatch")
+        self.read_epoch += 1
         combo, bases, words = self._encode_locked(
             cap, n, key_ids, ts_rel, cols, valid, null_streams)
         step = lattice.compiled_encoded_step(
@@ -901,6 +917,7 @@ class QueryExecutor:
         wm_rel = np.int32(max(self.watermark_abs - self.epoch, -1)
                           if self.watermark_abs >= 0 else -1)
         self._note_late(ts_list)
+        self.read_epoch += 1
         step = lattice.compiled_encoded_step(
             self.spec, self.schema, self._filter_expr, staged.combo,
             staged.cap, donate_words=True)
@@ -1138,6 +1155,7 @@ class QueryExecutor:
         if not starts:
             return []
         ows = [(s, self._open.pop(s).slot) for s in starts]
+        self.read_epoch += 1
         self.close_stats["close_cycles"] += 1
         if not self._fused_close_ok:
             return self._close_windows_ref(ows)
@@ -1364,6 +1382,25 @@ class QueryExecutor:
         return out
 
     # ---- pull queries (materialized views) ---------------------------------
+
+    # contract: dispatches<=0 fetches<=0
+    def read_version(self) -> tuple:
+        """Exact version of the peek-visible aggregate: equal tuples
+        guarantee peek() would return the same rows (the read cache's
+        validity key — ISSUE 20). Host ints only; lock-free readers get
+        at worst a spurious mismatch."""
+        return ("agg", self._read_nonce, self.read_epoch,
+                self.close_stats["close_cycles"], self.watermark_abs)
+
+    # contract: dispatches<=0 fetches<=0
+    def live_min_win_end(self) -> int | None:
+        """Smallest winEnd any live (open OR due-but-unclosed) window
+        could emit, or None when no live window exists. Lets a reader
+        whose WHERE bounds winEnd strictly below this skip peek()
+        entirely — closed rows alone answer the query (ISSUE 20)."""
+        if self.window is None or not self._open:
+            return None
+        return min(self._open) + self.window.size_ms
 
     # contract: dispatches<=1 fetches<=1
     def peek(self) -> list[dict[str, Any]]:

@@ -292,6 +292,25 @@ class _JoinBase:
     def peek(self) -> list[dict[str, Any]]:
         return [] if self._inner is None else self._inner.peek()
 
+    # contract: dispatches<=0 fetches<=0
+    def read_version(self) -> tuple | None:
+        """Read-cache validity key (ISSUE 20): peek() serves the inner
+        aggregate's state, so the version IS the inner's — prefixed
+        pre-creation so an empty join caches too. None (inner without
+        versioning) disables caching for this executor."""
+        inner = self._inner
+        if inner is None:
+            return ("join-empty", id(self))
+        fn = getattr(inner, "read_version", None)
+        return None if fn is None else fn()
+
+    # contract: dispatches<=0 fetches<=0
+    def live_min_win_end(self) -> int | None:
+        """Smallest live winEnd of the inner aggregate (ISSUE 20
+        closed-only fast path); None = no live window could emit one."""
+        fn = getattr(self._inner, "live_min_win_end", None)
+        return None if fn is None else fn()
+
     def close_due_windows(self) -> list[dict[str, Any]]:
         if self._inner is None or not hasattr(self._inner,
                                               "close_due_windows"):

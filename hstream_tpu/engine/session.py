@@ -45,7 +45,7 @@ from hstream_tpu.common.errors import SQLCodegenError
 from hstream_tpu.common.faultinject import FAULTS
 from hstream_tpu.common.logger import get_logger
 from hstream_tpu.common.tracing import kernel_family
-from hstream_tpu.engine.executor import QueryExecutor
+from hstream_tpu.engine.executor import _READ_NONCE, QueryExecutor
 from hstream_tpu.engine.expr import (
     columns_of,
     compile_device,
@@ -351,6 +351,12 @@ class SessionExecutor:
             if t == ColumnType.STRING
         }
         self._code_cols_cache: tuple[int, list[np.ndarray]] = (-1, [])
+        # read-plane versioning (ISSUE 20): bumped at every mutation
+        # entry point (ingest, close, engine migration) so equal
+        # read_version() tuples guarantee identical peek() results.
+        # Plain int — lock-free readers at worst miss spuriously.
+        self.read_epoch = 0
+        self._read_nonce = next(_READ_NONCE)
 
     # QueryExecutor._extract_filter reads self.node only.
 
@@ -418,6 +424,7 @@ class SessionExecutor:
                 ts_ms: Sequence[int]) -> list[dict[str, Any]]:
         if not rows:
             return []
+        self.read_epoch += 1
         if self._device_ready():
             out = self._process_rows_device(rows, ts_ms)
             if out is not _DEGRADED:
@@ -737,6 +744,7 @@ class SessionExecutor:
         # The reference never eagerly deletes session state
         # (SessionWindowedStream.hs:84-118); closing one gap-width later
         # preserves its merge-on-overlap semantics while still emitting.
+        self.read_epoch += 1
         if self._dev is not None:
             return self._close_due_device()
         gap, grace = self.window.gap_ms, self.window.grace_ms
@@ -917,6 +925,33 @@ class SessionExecutor:
         pairs = [(key, s) for key, sess_list in self.sessions.items()
                  for s in sess_list]
         return self._emit_cols_batch(pairs)
+
+    # contract: dispatches<=0 fetches<=0
+    def read_version(self) -> tuple:
+        """Exact version of the peek-visible session set (the read
+        cache's validity key — ISSUE 20): equal tuples guarantee peek()
+        would return the same rows. Host ints only, lock-free safe."""
+        return ("sess", self._read_nonce, self.read_epoch,
+                self.session_stats["close_cycles"], self.watermark)
+
+    # contract: dispatches<=0 fetches<=0
+    def live_min_win_end(self) -> int | None:
+        """Smallest winEnd any open session could emit (session winEnd
+        is end + gap), or None when no session is open — read off the
+        host dict or the device interval mirror, never the arena
+        (ISSUE 20: closed-only readers skip peek() entirely)."""
+        gap = self.window.gap_ms
+        if self._dev is not None:
+            dev = self._dev
+            live = dev["mir_live"]
+            if not live.any():
+                return None
+            return int(dev["mir_t1"][live].min()) + gap
+        ends = [s.end for sess_list in self.sessions.values()
+                for s in sess_list]
+        if not ends:
+            return None
+        return min(ends) + gap
 
     # ---- device session path (engine.lattice session kernels) --------------
     #
@@ -1125,6 +1160,7 @@ class SessionExecutor:
         }
         self.epoch = epoch
         self.sessions = {}
+        self.read_epoch += 1
 
     def _degrade_to_host(self, reason: str) -> None:
         """Pull the device state back into the host session dict and pin
@@ -1146,6 +1182,7 @@ class SessionExecutor:
         self._dev = None
         self.use_device_sessions = False
         self.device_fallbacks += 1
+        self.read_epoch += 1
 
     # contract: dispatches<=0 fetches<=1
     def _host_sessions_view(self) -> dict[tuple, list[_Session]]:
@@ -1250,6 +1287,7 @@ class SessionExecutor:
         n = len(ts_ms)
         if n == 0:
             return []
+        self.read_epoch += 1
         if self._device_ready():
             out = self._process_columnar_device(
                 np.asarray(ts_ms, np.int64), cols, nulls)

@@ -403,6 +403,10 @@ class Gateway:
             flow = self._admin("flow-status")
         except grpc.RpcError:
             flow = None
+        try:
+            read_cache = self._admin("read-cache")
+        except grpc.RpcError:
+            read_cache = None
         pipeline: dict[str, Any] = {}
         qids = [q.id for q in queries] + [f"view-{v.view_id}"
                                           for v in views]
@@ -429,6 +433,7 @@ class Gateway:
                 "rates": {k: round(v, 3) for k, v in s.rates.items()},
             } for s in stats.stats],
             "flow": flow,
+            "read_cache": read_cache,
             "pipeline_stages": pipeline,
         }
 

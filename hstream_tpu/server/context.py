@@ -50,6 +50,8 @@ class ServerContext:
                  heartbeat_lease_ms: float | None = None,
                  pack_queries: bool = False,
                  device_time_sample: int = 0,
+                 read_max_staleness_ms: float | None = None,
+                 read_cache_bytes: int = 64 << 20,
                  owns_store: bool = True):
         self.store = store
         # in-process multi-node clusters share ONE store across several
@@ -73,6 +75,15 @@ class ServerContext:
         self.persistence = persistence
         self.views = ViewRegistry()
         self.subscriptions = SubscriptionRegistry()
+        # read plane (ISSUE 20): version-validated snapshot cache for
+        # pull queries + the shared-encode expansion cache subscription
+        # fan-out rides on; budget 0 disables caching entirely
+        from hstream_tpu.server.readcache import ReadCache
+
+        self.read_cache = (ReadCache(
+            max_bytes=int(read_cache_bytes),
+            max_staleness_ms=read_max_staleness_ms)
+            if int(read_cache_bytes) > 0 else None)
         # query_id -> QueryTask; connector_id -> ConnectorTask
         self.running_queries: dict[str, object] = {}
         self.running_connectors: dict[str, object] = {}
@@ -99,6 +110,11 @@ class ServerContext:
         # sampler-style gauge: the holder calls it at scrape time
         self.stats.gauge_fn("event_journal_size", "",
                             lambda: len(self.events))
+        if self.read_cache is not None:
+            self.stats.gauge_fn("read_cache_hit_ratio", "",
+                                self.read_cache.hit_ratio)
+            self.stats.gauge_fn("read_cache_bytes", "",
+                                self.read_cache.nbytes)
         self.slow_request_ms = float(slow_request_ms)
         # cross-component trace spans (ISSUE 13): bounded per-scope
         # rings + the --trace-sample knob; disarmed (rate 0) cost is

@@ -917,15 +917,13 @@ class HStreamApiServicer:
         if flow.active and got:
             flow.charge_read(rt.meta.stream_name, len(got))
         out = pb.FetchResponse()
-        nbytes = 0
         for rid, payload in got:
             out.received_records.append(pb.ReceivedRecord(
                 record_id=pb.RecordId(batch_id=rid.lsn,
                                       batch_index=rid.idx),
                 record=payload))
-            nbytes += len(payload)
-        if got:
-            self.ctx.stats.note_read(rt.meta.stream_name, len(got), nbytes)
+        # read accounting (note_read) moved into SubscriptionRuntime
+        # .fetch so the streaming dispatcher's drains count too
         return out
 
     @unary
@@ -1216,6 +1214,14 @@ class HStreamApiServicer:
                    for scope, q in ctx.flow.list_quotas().items()}
         elif cmd == "flow-status":
             out = ctx.flow.status()
+        elif cmd == "read-cache":
+            # read plane (ISSUE 20): snapshot/expansion cache counters
+            cache = getattr(ctx, "read_cache", None)
+            out = ({"enabled": False} if cache is None
+                   else {"enabled": True,
+                         "max_bytes": cache.max_bytes,
+                         "max_staleness_ms": cache.max_staleness_ms,
+                         **cache.stats()})
         elif cmd == "fault-set":
             try:
                 ctx.faults.arm(str(args["site"]), str(args["spec"]))
@@ -1505,11 +1511,30 @@ class HStreamApiServicer:
                     and plan.view not in ctx.views.names():
                 return self._select_virtual(plan)
             mat = ctx.views.get(plan.view)
-            return serve_select_view(mat, plan.select)
+            return self._serve_view(plan.view, mat, plan.select, sql)
         if isinstance(plan, plans.SelectPlan):
             raise ServerError(
                 "push queries (EMIT CHANGES) go through ExecutePushQuery")
         raise ServerError(f"cannot execute {type(plan).__name__}")
+
+    def _serve_view(self, name: str, mat, select, sql: str
+                    ) -> list[dict[str, Any]]:
+        """Pull-query serve through the read plane (ISSUE 20): the
+        snapshot cache collapses N concurrent readers onto ONE executor
+        extract per close cycle; `read_out_records` / `read_extracts`
+        carry the serve rates per view."""
+        ctx = self.ctx
+        cache = getattr(ctx, "read_cache", None)
+        if cache is None:
+            return serve_select_view(mat, select)
+        rows, _how, extracted = cache.serve_view(name, mat, select, sql)
+        try:
+            ctx.stats.stat_add("read_out_records", name, float(len(rows)))
+            if extracted:
+                ctx.stats.stream_stat_add("read_extracts", name)
+        except Exception:  # noqa: BLE001 — metrics must not fail reads
+            pass
+        return rows
 
     def _select_virtual(self, plan) -> list[dict[str, Any]]:
         """LDQuery-lite (reference hs_ldquery.cpp:1-175): plain SQL —
@@ -1812,6 +1837,9 @@ class HStreamApiServicer:
         if task is not None:
             task.stop()
         ctx.views.remove(view)
+        cache = getattr(ctx, "read_cache", None)
+        if cache is not None:
+            cache.invalidate_view(view)
         try:
             ctx.persistence.remove_query(query_id)
         except QueryNotFound:

@@ -63,6 +63,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
           heartbeat_lease_ms: float | None = None,
           pack_queries: bool = False,
           device_time_sample: int = 0,
+          read_max_staleness_ms: float | None = None,
+          read_cache_bytes: int = 64 << 20,
           owns_store: bool = True
           ) -> tuple[grpc.Server, ServerContext]:
     """Start a server; returns (grpc_server, ctx). Caller owns shutdown.
@@ -104,6 +106,8 @@ def serve(host: str = "127.0.0.1", port: int = 6570,
                         heartbeat_lease_ms=heartbeat_lease_ms,
                         pack_queries=pack_queries,
                         device_time_sample=device_time_sample,
+                        read_max_staleness_ms=read_max_staleness_ms,
+                        read_cache_bytes=read_cache_bytes,
                         owns_store=owns_store)
     if faults:
         # chaos harness: arm fault sites for this run (same grammar as
@@ -283,6 +287,16 @@ def _parse_args(argv):
                          "dispatch, 0 = disarmed; default 0). "
                          "Disarmed cost is one attribute read + one "
                          "branch per dispatch")
+    ap.add_argument("--read-max-staleness-ms", type=float, default=None,
+                    help="read plane: age-bound snapshot-cache hits to "
+                         "this many ms (exactness already comes from "
+                         "the version key; this is a freshness SLA "
+                         "backstop). Unset = no age bound")
+    ap.add_argument("--read-cache-bytes", type=int, default=None,
+                    help="read plane: LRU byte budget shared by the "
+                         "pull-query snapshot cache and the "
+                         "subscription shared-encode cache "
+                         "(0 disables both; default 64 MiB)")
     ap.add_argument("--pack-queries", action="store_true", default=None,
                     help="co-compile packing: bucket compatible "
                          "queries (same source/window/agg signature) "
@@ -313,7 +327,9 @@ def _parse_args(argv):
                 "placer_interval_ms": None,
                 "heartbeat_lease_ms": None,
                 "pack_queries": False,
-                "device_time_sample": 0}
+                "device_time_sample": 0,
+                "read_max_staleness_ms": None,
+                "read_cache_bytes": 64 << 20}
     if args.config:
         with open(args.config) as f:
             file_cfg = json.load(f)
@@ -365,7 +381,9 @@ def main(argv=None) -> None:
         placer_interval_ms=cfg["placer_interval_ms"],
         heartbeat_lease_ms=cfg["heartbeat_lease_ms"],
         pack_queries=cfg["pack_queries"],
-        device_time_sample=cfg["device_time_sample"])
+        device_time_sample=cfg["device_time_sample"],
+        read_max_staleness_ms=cfg["read_max_staleness_ms"],
+        read_cache_bytes=cfg["read_cache_bytes"])
     stop = {"flag": False}
 
     def on_signal(signum, frame):

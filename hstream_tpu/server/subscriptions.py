@@ -246,18 +246,37 @@ class SubscriptionRuntime:
         r.set_timeout(int(timeout_ms))
         t0 = time.perf_counter()
         results = r.read(max(int(max_size), 1))
+        # columnar expansion OUTSIDE the runtime lock (ISSUE 20): the
+        # decode + per-row re-serialization is the expensive half of a
+        # fetch. Log records are immutable, so the shared expansion
+        # cache encodes each one ONCE per process and every consumer
+        # of the stream reuses the same frame bytes by reference —
+        # the encode-once fan-out half of the read plane. Lock hold
+        # time shrinks to pure ack-window bookkeeping.
+        cache = getattr(self.ctx, "read_cache", None)
+        expanded: list[tuple[Any, list[bytes] | None]] = []
+        for item in results:
+            if not isinstance(item, DataBatch):
+                expanded.append((item, None))
+                continue
+            payloads: list[bytes] = []
+            for i, payload in enumerate(item.payloads):
+                if cache is not None:
+                    frames = cache.expand_frames(
+                        self.logid, item.lsn, i, payload,
+                        _expand_columnar)
+                else:
+                    frames = _expand_columnar(payload)
+                if frames is None:
+                    payloads.append(payload)
+                else:
+                    payloads.extend(frames)
+            expanded.append((item, payloads))
         out: list[tuple[RecId, bytes]] = []
         newest = 0
         with self.lock:
-            for item in results:
-                if isinstance(item, DataBatch):
-                    payloads: list[bytes] = []
-                    for payload in item.payloads:
-                        expanded = _expand_columnar(payload)
-                        if expanded is None:
-                            payloads.append(payload)
-                        else:
-                            payloads.extend(expanded)
+            for item, payloads in expanded:
+                if payloads is not None:
                     self.window.note_batch(item.lsn, len(payloads))
                     for i, payload in enumerate(payloads):
                         out.append((RecId(item.lsn, i), payload))
@@ -275,10 +294,17 @@ class SubscriptionRuntime:
                     # rate a consumer group actually drains at — both
                     # the unary Fetch and the streaming dispatcher
                     # land here
+                    nbytes = sum(len(p) for _r, p in out)
                     stats.stat_add("delivered_records", self.sub_id,
                                    float(len(out)))
                     stats.stat_add("delivered_bytes", self.sub_id,
-                                   float(sum(len(p) for _r, p in out)))
+                                   float(nbytes))
+                    # read-side rate of the source stream (ISSUE 20):
+                    # every subscription drain — unary Fetch AND the
+                    # streaming dispatcher — is a read of that stream
+                    # (the handler no longer double-counts it)
+                    stats.note_read(self.meta.stream_name, len(out),
+                                    nbytes)
                 except Exception:  # noqa: BLE001 — metrics must not
                     pass           # kill delivery
         return out

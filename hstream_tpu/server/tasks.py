@@ -293,6 +293,18 @@ class QueryTask(threading.Thread):
             return None
         return int(wm)
 
+    def read_version(self) -> tuple | None:
+        """The executor's read-plane version tuple (ISSUE 20) — what
+        the read cache validates snapshot hits against. None while no
+        executor runs or the engine carries no versioning (stateless):
+        such state never caches."""
+        with self.state_lock:  # executor is guarded (hstream-analyze)
+            ex = self.executor
+        if ex is None:
+            return None
+        fn = getattr(ex, "read_version", None)
+        return None if fn is None else fn()
+
     def engine_total(self, attr: str) -> int:
         """Sum a host counter over the executor AND a join's lazily
         created inner aggregate (device_fallbacks, late_drops) — the

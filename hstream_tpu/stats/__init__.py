@@ -96,6 +96,10 @@ PER_STREAM_COUNTERS = [
                                # the heartbeat-lease CAS (try_adopt_
                                # live), boot adoption NOT included
                                # (label: query id)
+    "read_extracts",           # pull-query serves that actually ran an
+                               # executor peek (read-plane contract:
+                               # ~one per view per close cycle, not one
+                               # per reader; label: view name)
 ]
 
 # stream-scoped rate families, in the (name, bucket-widths) tuple
@@ -162,6 +166,12 @@ GAUGES = [
                               # memory_stats() where the platform
                               # provides it (absent on CPU) — the
                               # allocator-side cross-check of the fold
+    "read_cache_hit_ratio",   # read plane: (hits+shared)/(all versioned
+                              # serves) of the snapshot cache, sampled
+                              # at scrape
+    "read_cache_bytes",       # read plane: bytes held by the snapshot +
+                              # shared-encode LRU (budget via
+                              # --read-cache-bytes), sampled at scrape
 ]
 
 # Fixed-bucket latency histograms (Prometheus-style cumulative buckets);

@@ -77,7 +77,16 @@ def live_entity_keys(ctx, scope: str) -> set[str]:
     Raises whatever the underlying registry raises; callers choose
     fail-open vs skip."""
     if scope == "stream":
-        return set(ctx.streams.find_streams())
+        keys = set(ctx.streams.find_streams())
+        # materialized views are live READ endpoints: pull queries feed
+        # stream-scoped families (read_out_records, read_extracts) keyed
+        # by view name, which must survive the liveness sweep until the
+        # view itself is dropped (ISSUE 20 read plane)
+        try:
+            keys.update(ctx.views.names())
+        except Exception:  # noqa: BLE001 — bare test contexts
+            pass
+        return keys
     if scope == "subscription":
         return {rt.sub_id for rt in ctx.subscriptions.list()}
     if scope == "query":

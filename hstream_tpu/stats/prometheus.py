@@ -162,6 +162,13 @@ _HELP = {
     "kernel_device_ms": "device execution time per kernel family "
                         "(fenced block-until-ready on a deterministic "
                         "1/N dispatch sample, --device-time-sample)",
+    "read_extracts": "pull-query serves that actually ran an executor "
+                     "peek (~one per view per close cycle, not one "
+                     "per reader)",
+    "read_cache_hit_ratio": "snapshot-cache hit ratio over all "
+                            "versioned pull-query serves",
+    "read_cache_bytes": "bytes held by the read-plane snapshot + "
+                        "shared-encode LRU (--read-cache-bytes)",
 }
 
 # rate-family HELP text lives on the declaration itself (the one-line

@@ -225,6 +225,12 @@ def _golden_holder() -> StatsHolder:
     stats.stream_stat_add("lock_contention", "tasks.state", 3)
     stats.observe("lock_wait_ms", "tasks.state", 0.8)
     stats.observe("lock_hold_ms", "tasks.state", 2.0)
+    # read plane (ISSUE 20): view-labeled extract counter, the
+    # read_out_records rate ladder, and the cache gauges
+    stats.stream_stat_add("read_extracts", "v1", 2)
+    stats.stat_add("read_out_records", "v1", 9.0, now=BASE / 1000)
+    stats.gauge_set("read_cache_hit_ratio", "", 0.75)
+    stats.gauge_set("read_cache_bytes", "", 16384)
     return stats
 
 

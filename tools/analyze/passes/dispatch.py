@@ -93,6 +93,10 @@ HOT_PATH_FILES = (
     # inside every kernel_family scope — each hook declares its budget
     # (the sampler's fence/measure are the ONLY sanctioned syncs)
     "hstream_tpu/stats/devicecost.py",
+    # the read plane (ISSUE 20): serve_view sits on every pull query —
+    # its budget is one extract dispatch + one fetch per cache miss,
+    # and a bare sync creeping into the hit path would tax every reader
+    "hstream_tpu/server/readcache.py",
 )
 
 # factories whose RESULT is a compiled kernel callable
